@@ -1,0 +1,68 @@
+"""madsim_tpu — a TPU-native deterministic simulation testing (DST) framework.
+
+A brand-new framework with the capabilities of madsim (Rust DST in the
+FoundationDB tradition): a seeded single-threaded executor with virtual time,
+a fault-injecting network simulator, node kill/restart/pause supervision,
+drop-in shims for gRPC/etcd/Kafka/S3-style workloads, and a seed-sweep test
+driver with bit-exact replay.  On top of the host tier, the inner simulation
+loop is re-designed as a JAX/Pallas struct-of-arrays engine
+(``madsim_tpu.engine``) that steps thousands of seeds in lockstep on TPU.
+
+Layer map (mirrors reference /root/reference, see SURVEY.md §1):
+  L0 determinism core   -> madsim_tpu.rand        (madsim/src/sim/rand.rs)
+  L1 virtual time       -> madsim_tpu.time        (madsim/src/sim/time/)
+  L2 task scheduler     -> madsim_tpu.task        (madsim/src/sim/task/)
+  L3 runtime + plugins  -> madsim_tpu.runtime     (madsim/src/sim/runtime/)
+  L4 device simulators  -> madsim_tpu.net, .fs    (madsim/src/sim/{net,fs})
+  L5 protocol layer     -> madsim_tpu.net.{endpoint,rpc}
+  L6 ecosystem shims    -> madsim_tpu.{grpc,etcd,kafka,s3}
+  L7 codegen/macros     -> decorators (@sim_test, @service, @request)
+  L8 test driver        -> madsim_tpu.builder
+  TPU tier              -> madsim_tpu.{engine,models,parallel,ops}
+
+(The L6 ecosystem shims and the TPU tier are built progressively — check the
+package tree for what is present in this revision.)
+"""
+
+__version__ = "0.1.0"
+
+from . import buggify as buggify
+from . import rand as rand
+from . import time as time
+from .builder import Builder, main, sim_test
+from .context import current_handle, current_node, current_task
+from .futures import Future, JoinHandle, select, join, pending_forever
+from .runtime import Handle, NodeBuilder, Runtime, init_logger
+from .task import spawn, spawn_local, NodeId, exit_current_task
+from .time import sleep, sleep_until, timeout, interval, Instant, TimeoutError
+
+__all__ = [
+    "Builder",
+    "Future",
+    "Handle",
+    "Instant",
+    "JoinHandle",
+    "NodeBuilder",
+    "NodeId",
+    "Runtime",
+    "TimeoutError",
+    "buggify",
+    "current_handle",
+    "current_node",
+    "current_task",
+    "exit_current_task",
+    "init_logger",
+    "interval",
+    "join",
+    "main",
+    "pending_forever",
+    "rand",
+    "select",
+    "sim_test",
+    "sleep",
+    "sleep_until",
+    "spawn",
+    "spawn_local",
+    "time",
+    "timeout",
+]

@@ -1,0 +1,34 @@
+"""FoundationDB-style cooperative fault injection
+(ref madsim/src/sim/buggify.rs:8-32; RNG gate in sim/rand.rs:113-134).
+
+``buggify()`` returns True 25% of the time *when enabled* (disabled by
+default); simulator code sprinkles ``if buggify():`` at interesting points
+(e.g. the network layer turns a 0-5 µs delay into 1-5 s at 10%,
+net/mod.rs:287-295).  Draws flow through the GlobalRng, so they are seeded
+and appear in the determinism log.
+"""
+
+from __future__ import annotations
+
+from .context import current_handle
+
+
+def enable() -> None:
+    current_handle().rng.buggify_enabled = True
+
+
+def disable() -> None:
+    current_handle().rng.buggify_enabled = False
+
+
+def is_enabled() -> bool:
+    return current_handle().rng.buggify_enabled
+
+
+def buggify() -> bool:
+    """25% chance when enabled, else False (buggify.rs:8-20)."""
+    return current_handle().rng.buggify()
+
+
+def buggify_with_prob(prob: float) -> bool:
+    return current_handle().rng.buggify_with_prob(prob)

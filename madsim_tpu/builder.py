@@ -1,0 +1,203 @@
+"""Seed-sweep test driver (ref madsim/src/sim/runtime/builder.rs:7-162).
+
+Reads ``MADSIM_TEST_{SEED,NUM,JOBS,CONFIG,TIME_LIMIT,CHECK_DETERMINISM}`` and
+``MADSIM_ALLOW_SYSTEM_THREAD`` from the environment, runs ``count`` seeds
+(seed, seed+1, ...) with ``jobs`` concurrent OS threads (one fresh thread per
+seed, like the reference's ``std::thread::spawn`` + ``buffer_unordered``),
+and on failure prints the reproducing ``MADSIM_TEST_SEED`` (ref
+runtime/mod.rs:205-210).
+
+The ``@sim_test`` decorator is the analogue of ``#[madsim::test]``
+(madsim-macros/src/lib.rs:88-152): it rewrites an async test into a sync
+function that drives ``Builder.from_env().run(...)`` — directly collectable
+by pytest.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import sys
+import threading
+from typing import Any, Callable, Coroutine, List, Optional
+
+from .config import Config
+from .runtime import Runtime
+
+AsyncFn = Callable[..., Coroutine[Any, Any, Any]]
+
+
+def _env_int(name: str) -> Optional[int]:
+    v = os.environ.get(name)
+    return int(v) if v not in (None, "") else None
+
+
+def _env_flag(name: str) -> bool:
+    return os.environ.get(name, "") not in ("", "0", "false", "False")
+
+
+class Builder:
+    """Configurable multi-seed test runner (ref ``Builder``, builder.rs)."""
+
+    def __init__(
+        self,
+        seed: Optional[int] = None,
+        count: int = 1,
+        jobs: int = 1,
+        config: Optional[Config] = None,
+        time_limit: Optional[float] = None,
+        check_determinism: bool = False,
+        allow_system_thread: bool = False,
+    ):
+        if seed is None:
+            import time as _walltime
+
+            seed = _walltime.time_ns()  # new schedule per run (builder.rs:64-73)
+        self.seed = seed
+        self.count = count
+        self.jobs = jobs
+        self.config = config
+        self.time_limit = time_limit
+        self.check_determinism = check_determinism
+        self.allow_system_thread = allow_system_thread
+
+    @classmethod
+    def from_env(cls, **overrides: Any) -> "Builder":
+        """ref builder.rs:63-117."""
+        cfg: Optional[Config] = None
+        cfg_path = os.environ.get("MADSIM_TEST_CONFIG")
+        if cfg_path:
+            with open(cfg_path, "r") as f:
+                cfg = Config.from_toml(f.read())
+        kwargs: dict = dict(
+            seed=_env_int("MADSIM_TEST_SEED"),
+            count=_env_int("MADSIM_TEST_NUM") or 1,
+            jobs=_env_int("MADSIM_TEST_JOBS") or 1,
+            config=cfg,
+            time_limit=(
+                float(os.environ["MADSIM_TEST_TIME_LIMIT"])
+                if os.environ.get("MADSIM_TEST_TIME_LIMIT")
+                else None
+            ),
+            check_determinism=_env_flag("MADSIM_TEST_CHECK_DETERMINISM"),
+            allow_system_thread=_env_flag("MADSIM_ALLOW_SYSTEM_THREAD"),
+        )
+        for k, v in overrides.items():
+            if v is not None:
+                kwargs[k] = v
+        return cls(**kwargs)
+
+    def _run_one(self, seed: int, test_fn: Callable[[], Coroutine]) -> Any:
+        if self.check_determinism:
+            return Runtime.check_determinism(seed, test_fn, config=self.config)
+        rt = Runtime(seed=seed, config=self.config)
+        if self.time_limit is not None:
+            rt.set_time_limit(self.time_limit)
+        rt.set_allow_system_thread(self.allow_system_thread)
+        return rt.block_on(test_fn())
+
+    def run(self, test_fn: Callable[[], Coroutine]) -> Any:
+        """Run the async test over ``count`` seeds (ref builder.rs:120-161)."""
+        seeds = list(range(self.seed, self.seed + self.count))
+        if self.jobs <= 1 or self.count == 1:
+            last = None
+            for seed in seeds:
+                try:
+                    last = self._run_one(seed, test_fn)
+                except BaseException:
+                    _print_repro(seed)
+                    raise
+            return last
+
+        failures: List[tuple] = []
+        results: dict = {}
+        lock = threading.Lock()
+        sem = threading.Semaphore(self.jobs)
+
+        def worker(seed: int) -> None:
+            try:
+                r = self._run_one(seed, test_fn)
+                with lock:
+                    results[seed] = r
+            except BaseException as e:  # noqa: BLE001
+                with lock:
+                    failures.append((seed, e))
+            finally:
+                sem.release()
+
+        threads = []
+        for seed in seeds:
+            sem.acquire()
+            if failures:
+                sem.release()
+                break
+            t = threading.Thread(target=worker, args=(seed,), daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join()
+        if failures:
+            failures.sort(key=lambda f: f[0])
+            seed, exc = failures[0]
+            _print_repro(seed)
+            raise exc
+        # match the sequential path: the last seed's result
+        return results[max(results)] if results else None
+
+
+def _print_repro(seed: int) -> None:
+    print(
+        f"note: run with `MADSIM_TEST_SEED={seed}` environment variable "
+        f"to reproduce this failure",
+        file=sys.stderr,
+    )
+
+
+def sim_test(
+    fn: Optional[AsyncFn] = None,
+    *,
+    seed: Optional[int] = None,
+    count: Optional[int] = None,
+    jobs: Optional[int] = None,
+    config: Optional[Config] = None,
+    time_limit: Optional[float] = None,
+    check_determinism: Optional[bool] = None,
+    allow_system_thread: Optional[bool] = None,
+) -> Any:
+    """``#[madsim::test]`` analogue — decorate an async test function.
+
+    Environment variables still win for seed/count/jobs unless explicitly
+    overridden, so a failing seed printed by a CI run can be replayed with
+    ``MADSIM_TEST_SEED=... pytest ...``.
+    """
+
+    def deco(f: AsyncFn) -> Callable[..., Any]:
+        @functools.wraps(f)
+        def wrapper(*args: Any, **kwargs: Any) -> Any:
+            env_seed = _env_int("MADSIM_TEST_SEED")
+            b = Builder.from_env(
+                seed=env_seed if env_seed is not None else seed,
+                count=count,
+                jobs=jobs,
+                config=config,
+                time_limit=time_limit,
+                check_determinism=check_determinism,
+                allow_system_thread=allow_system_thread,
+            )
+            return b.run(lambda: f(*args, **kwargs))
+
+        return wrapper
+
+    if fn is not None:
+        return deco(fn)
+    return deco
+
+
+def main(fn: AsyncFn) -> Callable[..., Any]:
+    """``#[madsim::main]`` analogue."""
+
+    @functools.wraps(fn)
+    def wrapper(*args: Any, **kwargs: Any) -> Any:
+        return Builder.from_env().run(lambda: fn(*args, **kwargs))
+
+    return wrapper

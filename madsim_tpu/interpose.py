@@ -1,0 +1,235 @@
+"""Python-level interposition of stdlib nondeterminism sources.
+
+The reference interposes at the libc boundary with ``#[no_mangle]`` symbol
+overrides: ``getrandom``/``getentropy`` route into GlobalRng
+(madsim/src/sim/rand.rs:197-260), ``clock_gettime``/``gettimeofday`` return
+sim time (sim/time/system_time.rs:4-113), and ``pthread_attr_init`` blocks
+thread creation unless ``MADSIM_ALLOW_SYSTEM_THREAD`` is set
+(sim/task/mod.rs:707-785).
+
+The Python analogue patches the stdlib entry points **once**, with dispatching
+wrappers that check the ambient sim context per call: inside a simulation they
+produce deterministic values from the runtime's GlobalRng / virtual clock;
+outside they fall through to the real implementation.  This makes the patch
+safe under concurrent seed-sweep threads (each thread has its own ambient
+handle) — the same property the reference gets from thread-local context.
+
+Intercepted:  ``random.*`` (module-level functions), ``os.urandom``,
+``uuid.uuid4``, ``time.{time,time_ns,monotonic,monotonic_ns,perf_counter,
+perf_counter_ns}``, ``threading.Thread.start`` (blocked in sim unless
+allowed).  Known gap (documented): ``datetime.datetime.now`` reads the OS
+clock from C and cannot be patched — use ``madsim_tpu.time.now()``.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import uuid as _uuid_mod
+from contextlib import contextmanager
+from typing import Any, Iterator
+
+from .context import try_current_handle
+
+_lock = threading.Lock()
+_install_count = 0
+_originals: dict = {}
+
+
+class _SimRandomDispatch:
+    """random-module replacement functions backed by the ambient GlobalRng."""
+
+    @staticmethod
+    def random() -> float:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.random"]()
+        return h.rng.random()
+
+    @staticmethod
+    def getrandbits(k: int) -> int:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.getrandbits"](k)
+        out = 0
+        bits = 0
+        while bits < k:
+            out |= h.rng.next_u64() << bits
+            bits += 64
+        return out & ((1 << k) - 1)
+
+    @staticmethod
+    def randbytes(n: int) -> bytes:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.randbytes"](n)
+        return h.rng.sample_bytes(n)
+
+    @staticmethod
+    def randrange(start: int, stop: Any = None, step: int = 1) -> int:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.randrange"](start, stop, step)
+        if stop is None:
+            start, stop = 0, start
+        width = (stop - start + step - 1) // step if step > 0 else None
+        if width is None or width <= 0:
+            raise ValueError("empty range for randrange")
+        return start + step * h.rng.gen_range(0, width)
+
+    @staticmethod
+    def randint(a: int, b: int) -> int:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.randint"](a, b)
+        return h.rng.gen_range(a, b + 1)
+
+    @staticmethod
+    def uniform(a: float, b: float) -> float:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.uniform"](a, b)
+        return h.rng.uniform(a, b)
+
+    @staticmethod
+    def choice(seq: Any) -> Any:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.choice"](seq)
+        return h.rng.choice(seq)
+
+    @staticmethod
+    def shuffle(seq: Any) -> None:
+        h = try_current_handle()
+        if h is None:
+            return _originals["random.shuffle"](seq)
+        return h.rng.shuffle(seq)
+
+
+def _sim_urandom(n: int) -> bytes:
+    h = try_current_handle()
+    if h is None:
+        return _originals["os.urandom"](n)
+    return h.rng.sample_bytes(n)
+
+
+def _sim_uuid4() -> "_uuid_mod.UUID":
+    h = try_current_handle()
+    if h is None:
+        return _originals["uuid.uuid4"]()
+    return _uuid_mod.UUID(bytes=h.rng.sample_bytes(16), version=4)
+
+
+def _make_clock(name: str, kind: str, ns: bool):
+    def clock() -> Any:
+        h = try_current_handle()
+        if h is None:
+            return _originals[name]()
+        t = h.time.now_time_ns() if kind == "wall" else h.time.now_ns
+        return t if ns else t / 1e9
+
+    clock.__name__ = name.split(".")[-1]
+    return clock
+
+
+def _sim_thread_start(self: threading.Thread, *args: Any, **kwargs: Any) -> Any:
+    h = try_current_handle()
+    if h is not None and not getattr(h, "allow_system_thread", False):
+        raise RuntimeError(
+            "attempted to spawn an OS thread inside a deterministic "
+            "simulation; real threads break determinism. Use "
+            "madsim_tpu.spawn() for concurrency, or set "
+            "MADSIM_ALLOW_SYSTEM_THREAD=1 if you know what you are doing "
+            "(ref: madsim blocks pthread creation, sim/task/mod.rs:761-785)"
+        )
+    return _originals["threading.Thread.start"](self, *args, **kwargs)
+
+
+def _install() -> None:
+    import random as _r
+    import time as _t
+
+    _originals.update(
+        {
+            "random.random": _r.random,
+            "random.getrandbits": _r.getrandbits,
+            "random.randbytes": _r.randbytes,
+            "random.randrange": _r.randrange,
+            "random.randint": _r.randint,
+            "random.uniform": _r.uniform,
+            "random.choice": _r.choice,
+            "random.shuffle": _r.shuffle,
+            "os.urandom": os.urandom,
+            "uuid.uuid4": _uuid_mod.uuid4,
+            "time.time": _t.time,
+            "time.time_ns": _t.time_ns,
+            "time.monotonic": _t.monotonic,
+            "time.monotonic_ns": _t.monotonic_ns,
+            "time.perf_counter": _t.perf_counter,
+            "time.perf_counter_ns": _t.perf_counter_ns,
+            "threading.Thread.start": threading.Thread.start,
+        }
+    )
+    _r.random = _SimRandomDispatch.random
+    _r.getrandbits = _SimRandomDispatch.getrandbits
+    _r.randbytes = _SimRandomDispatch.randbytes
+    _r.randrange = _SimRandomDispatch.randrange
+    _r.randint = _SimRandomDispatch.randint
+    _r.uniform = _SimRandomDispatch.uniform
+    _r.choice = _SimRandomDispatch.choice
+    _r.shuffle = _SimRandomDispatch.shuffle
+    os.urandom = _sim_urandom
+    _uuid_mod.uuid4 = _sim_uuid4
+    _t.time = _make_clock("time.time", "wall", ns=False)
+    _t.time_ns = _make_clock("time.time_ns", "wall", ns=True)
+    _t.monotonic = _make_clock("time.monotonic", "mono", ns=False)
+    _t.monotonic_ns = _make_clock("time.monotonic_ns", "mono", ns=True)
+    _t.perf_counter = _make_clock("time.perf_counter", "mono", ns=False)
+    _t.perf_counter_ns = _make_clock("time.perf_counter_ns", "mono", ns=True)
+    threading.Thread.start = _sim_thread_start  # type: ignore[method-assign]
+
+
+def _uninstall() -> None:
+    import random as _r
+    import time as _t
+
+    _r.random = _originals["random.random"]
+    _r.getrandbits = _originals["random.getrandbits"]
+    _r.randbytes = _originals["random.randbytes"]
+    _r.randrange = _originals["random.randrange"]
+    _r.randint = _originals["random.randint"]
+    _r.uniform = _originals["random.uniform"]
+    _r.choice = _originals["random.choice"]
+    _r.shuffle = _originals["random.shuffle"]
+    os.urandom = _originals["os.urandom"]
+    _uuid_mod.uuid4 = _originals["uuid.uuid4"]
+    _t.time = _originals["time.time"]
+    _t.time_ns = _originals["time.time_ns"]
+    _t.monotonic = _originals["time.monotonic"]
+    _t.monotonic_ns = _originals["time.monotonic_ns"]
+    _t.perf_counter = _originals["time.perf_counter"]
+    _t.perf_counter_ns = _originals["time.perf_counter_ns"]
+    threading.Thread.start = _originals["threading.Thread.start"]
+    _originals.clear()
+
+
+@contextmanager
+def interposed(handle: Any, allow_system_thread: bool = False) -> Iterator[None]:
+    """Enable stdlib interposition for the duration of a simulation run.
+
+    Installation is global but refcounted and dispatch is per-thread via the
+    ambient context, so concurrent seed-sweep threads are safe.
+    """
+    global _install_count
+    handle.allow_system_thread = allow_system_thread
+    with _lock:
+        if _install_count == 0:
+            _install()
+        _install_count += 1
+    try:
+        yield
+    finally:
+        with _lock:
+            _install_count -= 1
+            if _install_count == 0:
+                _uninstall()

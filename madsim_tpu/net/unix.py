@@ -1,0 +1,29 @@
+"""Unix domain sockets — intentionally unimplemented, matching the
+reference's stubs (madsim/src/sim/net/unix/{stream,datagram}.rs, all
+methods ``todo!()``)."""
+
+from __future__ import annotations
+
+from typing import Any
+
+
+class UnixStream:
+    @staticmethod
+    async def connect(path: str) -> "UnixStream":
+        raise NotImplementedError("unix sockets are not simulated (ref parity)")
+
+
+class UnixListener:
+    @staticmethod
+    async def bind(path: str) -> "UnixListener":
+        raise NotImplementedError("unix sockets are not simulated (ref parity)")
+
+
+class UnixDatagram:
+    @staticmethod
+    async def bind(path: str) -> "UnixDatagram":
+        raise NotImplementedError("unix sockets are not simulated (ref parity)")
+
+    @staticmethod
+    def unbound() -> Any:
+        raise NotImplementedError("unix sockets are not simulated (ref parity)")

@@ -1,0 +1,336 @@
+"""Virtual time: mock clock + timer heap + sleep/timeout/interval futures.
+
+Mirrors the reference's ``sim/time/`` tree:
+- ``TimeHandle`` / clock-jump loop        -> madsim/src/sim/time/mod.rs:21-230
+- base wall time randomized "around 2022" -> time/mod.rs:27-32
+- ``advance_to_next_event`` (+50ns eps)   -> time/mod.rs:45-60
+- minimum 1 ms sleep (tokio parity)       -> time/mod.rs:110-124
+- Sleep future (lazy timer registration)  -> sim/time/sleep.rs:20-55
+- Interval + MissedTickBehavior           -> sim/time/interval.rs:38-192
+- clock_gettime interposition equivalent  -> madsim_tpu.interpose
+                                             (ref: sim/time/system_time.rs)
+
+All internal arithmetic is integer nanoseconds (no float time math — this is
+also the invariant that keeps the TPU engine bit-exact, SURVEY.md §7).
+Public APIs take float seconds, converted once at the boundary.
+"""
+
+from __future__ import annotations
+
+import heapq
+from enum import Enum
+from typing import Any, Callable, Generator, List, Optional, Tuple
+
+from .context import current_handle
+from .futures import Future
+from .rand import GlobalRng
+
+NANOS_PER_SEC = 1_000_000_000
+MIN_SLEEP_NS = 1_000_000  # 1 ms, tokio parity (time/mod.rs:110-124)
+_JUMP_EPSILON_NS = 50  # time/mod.rs:45-60
+_EPOCH_2022_S = 1_640_995_200  # 2022-01-01T00:00:00Z
+
+
+class TimeoutError(Exception):
+    """Elapsed deadline from :func:`timeout` (tokio ``Elapsed``)."""
+
+
+def _to_ns(seconds: float) -> int:
+    if seconds < 0:
+        raise ValueError("duration must be non-negative")
+    return int(round(seconds * NANOS_PER_SEC))
+
+
+class Instant:
+    """Monotonic sim-time point; subtraction gives float seconds."""
+
+    __slots__ = ("ns",)
+
+    def __init__(self, ns: int):
+        self.ns = ns
+
+    def __sub__(self, other: "Instant") -> float:
+        return (self.ns - other.ns) / NANOS_PER_SEC
+
+    def __add__(self, seconds: float) -> "Instant":
+        return Instant(self.ns + _to_ns(seconds))
+
+    def elapsed(self) -> float:
+        return current_handle().time.now_instant() - self
+
+    def __eq__(self, other: object) -> bool:
+        return isinstance(other, Instant) and self.ns == other.ns
+
+    def __lt__(self, other: "Instant") -> bool:
+        return self.ns < other.ns
+
+    def __le__(self, other: "Instant") -> bool:
+        return self.ns <= other.ns
+
+    def __hash__(self) -> int:
+        return hash(("Instant", self.ns))
+
+    def __repr__(self) -> str:
+        return f"Instant({self.ns}ns)"
+
+
+class _TimerEntry:
+    __slots__ = ("deadline_ns", "callback", "cancelled")
+
+    def __init__(self, deadline_ns: int, callback: Callable[[], None]):
+        self.deadline_ns = deadline_ns
+        self.callback = callback
+        self.cancelled = False
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+
+class TimeHandle:
+    """Virtual clock + binary-heap timer queue (time/mod.rs:21-230)."""
+
+    def __init__(self, rng: GlobalRng):
+        # Base wall-clock randomized around 2022 (time/mod.rs:27-32) so no
+        # workload can depend on the absolute date.
+        self._epoch_ns = (
+            _EPOCH_2022_S * NANOS_PER_SEC
+            + rng.gen_range(0, 365 * 24 * 3600) * NANOS_PER_SEC
+        )
+        self._clock_ns = 0  # monotonic ns since sim start
+        self._heap: List[Tuple[int, int, _TimerEntry]] = []
+        self._seq = 0  # FIFO tie-break for equal deadlines
+        rng._now_ns = lambda: self._clock_ns
+
+    # -- clocks -----------------------------------------------------------
+
+    @property
+    def now_ns(self) -> int:
+        return self._clock_ns
+
+    def now_instant(self) -> Instant:
+        return Instant(self._clock_ns)
+
+    def now_time_ns(self) -> int:
+        """Simulated wall-clock (UNIX epoch ns) — SystemTime equivalent."""
+        return self._epoch_ns + self._clock_ns
+
+    def elapsed(self) -> float:
+        return self._clock_ns / NANOS_PER_SEC
+
+    # -- timers -----------------------------------------------------------
+
+    def add_timer_at_ns(
+        self, deadline_ns: int, callback: Callable[[], None]
+    ) -> _TimerEntry:
+        """Register a callback at an absolute monotonic deadline
+        (``TimeHandle::add_timer_at``, time/mod.rs:142-153)."""
+        entry = _TimerEntry(deadline_ns, callback)
+        self._seq += 1
+        heapq.heappush(self._heap, (deadline_ns, self._seq, entry))
+        return entry
+
+    def add_timer_ns(self, delay_ns: int, callback: Callable[[], None]) -> _TimerEntry:
+        return self.add_timer_at_ns(self._clock_ns + max(0, delay_ns), callback)
+
+    def add_timer(self, delay_s: float, callback: Callable[[], None]) -> _TimerEntry:
+        return self.add_timer_ns(_to_ns(delay_s), callback)
+
+    def next_deadline_ns(self) -> Optional[int]:
+        while self._heap:
+            deadline, _seq, entry = self._heap[0]
+            if entry.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            return deadline
+        return None
+
+    def _fire_due(self) -> int:
+        fired = 0
+        while self._heap:
+            deadline, _seq, entry = self._heap[0]
+            if entry.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            if deadline > self._clock_ns:
+                break
+            heapq.heappop(self._heap)
+            entry.callback()
+            fired += 1
+        return fired
+
+    def advance_ns(self, delta_ns: int) -> None:
+        """Jump the clock forward, firing any timers that become due
+        (``time::advance`` / per-poll 50-100ns advance)."""
+        self._clock_ns += delta_ns
+        self._fire_due()
+
+    def advance(self, seconds: float) -> None:
+        self.advance_ns(_to_ns(seconds))
+
+    def advance_to_next_event(self) -> bool:
+        """Pop the earliest timer and jump the clock to it (+50 ns epsilon);
+        returns False when no timers remain — the deadlock signal
+        (time/mod.rs:45-60)."""
+        deadline = self.next_deadline_ns()
+        if deadline is None:
+            return False
+        self._clock_ns = max(self._clock_ns, deadline + _JUMP_EPSILON_NS)
+        self._fire_due()
+        return True
+
+
+# -- Sleep future (sim/time/sleep.rs:20-55) --------------------------------
+
+
+class Sleep(Future):
+    """Resolves when the virtual clock reaches ``deadline``.
+
+    The timer is registered lazily on first poll (subscribe), matching the
+    reference's poll-registered waker (sleep.rs:30-44).
+    """
+
+    __slots__ = ("_time", "_deadline_ns", "_timer")
+
+    def __init__(self, time: TimeHandle, deadline_ns: int):
+        super().__init__()
+        self._time = time
+        self._deadline_ns = deadline_ns
+        self._timer: Optional[_TimerEntry] = None
+
+    @property
+    def deadline(self) -> Instant:
+        return Instant(self._deadline_ns)
+
+    def is_elapsed(self) -> bool:
+        return self.done()
+
+    def subscribe(self, task: Any) -> None:
+        if not self.done() and self._timer is None:
+            if self._deadline_ns <= self._time.now_ns:
+                self.set_result(None)
+            else:
+                self._timer = self._time.add_timer_at_ns(
+                    self._deadline_ns, lambda: self.set_result(None)
+                )
+        super().subscribe(task)
+
+    def reset(self, deadline: Instant) -> None:
+        """Move the deadline (``Sleep::reset``, sleep.rs:47-55)."""
+        if self._timer is not None:
+            self._timer.cancel()
+            self._timer = None
+        self._reset()
+        self._deadline_ns = deadline.ns
+
+
+def sleep(seconds: float) -> Sleep:
+    """Sleep for a virtual duration (min 1 ms, tokio parity)."""
+    t = current_handle().time
+    return Sleep(t, t.now_ns + max(_to_ns(seconds), MIN_SLEEP_NS))
+
+
+def sleep_until(deadline: Instant) -> Sleep:
+    t = current_handle().time
+    return Sleep(t, deadline.ns)
+
+
+async def timeout(seconds: float, awaitable: Any) -> Any:
+    """Await ``awaitable`` with a virtual-time deadline.
+
+    Coroutines are spawned as a task and aborted on timeout (the Python
+    analogue of dropping the future); Future-likes are raced directly.
+    Raises :class:`TimeoutError` on expiry (``time::timeout``,
+    time/mod.rs:183-196).
+    """
+    import inspect
+
+    from .futures import select
+    from .task import spawn
+
+    spawned = None
+    if inspect.iscoroutine(awaitable):
+        spawned = spawn(awaitable)
+        fut = spawned
+    else:
+        fut = awaitable
+    idx, value = await select(fut, sleep(seconds))
+    if idx == 0:
+        return value
+    if spawned is not None:
+        spawned.abort()
+    raise TimeoutError(f"deadline has elapsed after {seconds}s")
+
+
+# -- Interval (sim/time/interval.rs:38-192) --------------------------------
+
+
+class MissedTickBehavior(Enum):
+    BURST = "burst"
+    DELAY = "delay"
+    SKIP = "skip"
+
+
+class Interval:
+    """Periodic ticks with tokio ``MissedTickBehavior`` semantics."""
+
+    def __init__(self, time: TimeHandle, start_ns: int, period_ns: int):
+        if period_ns <= 0:
+            raise ValueError("interval period must be positive")
+        self._time = time
+        self._period_ns = period_ns
+        self._deadline_ns = start_ns
+        self.missed_tick_behavior = MissedTickBehavior.BURST
+
+    @property
+    def period(self) -> float:
+        return self._period_ns / NANOS_PER_SEC
+
+    async def tick(self) -> Instant:
+        await Sleep(self._time, self._deadline_ns)
+        scheduled = self._deadline_ns
+        now = self._time.now_ns
+        b = self.missed_tick_behavior
+        if b is MissedTickBehavior.BURST:
+            self._deadline_ns = scheduled + self._period_ns
+        elif b is MissedTickBehavior.DELAY:
+            self._deadline_ns = now + self._period_ns
+        else:  # SKIP: next multiple of period after now
+            missed = (now - scheduled) // self._period_ns + 1
+            self._deadline_ns = scheduled + missed * self._period_ns
+        return Instant(scheduled)
+
+    def reset(self) -> None:
+        self._deadline_ns = self._time.now_ns + self._period_ns
+
+
+def interval(period: float) -> Interval:
+    """First tick completes immediately (tokio ``interval``)."""
+    t = current_handle().time
+    return Interval(t, t.now_ns, _to_ns(period))
+
+
+def interval_at(start: Instant, period: float) -> Interval:
+    t = current_handle().time
+    return Interval(t, start.ns, _to_ns(period))
+
+
+# -- ambient conveniences --------------------------------------------------
+
+
+def now_instant() -> Instant:
+    return current_handle().time.now_instant()
+
+
+def now() -> float:
+    """Simulated wall-clock time as float UNIX seconds (SystemTime::now)."""
+    return current_handle().time.now_time_ns() / NANOS_PER_SEC
+
+
+def elapsed() -> float:
+    """Seconds of virtual time since the simulation started."""
+    return current_handle().time.elapsed()
+
+
+def advance(seconds: float) -> None:
+    """Manually advance the virtual clock (``time::advance``)."""
+    current_handle().time.advance(seconds)

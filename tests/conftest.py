@@ -1,0 +1,15 @@
+"""Test environment: force JAX onto CPU with 8 virtual devices so sharding
+tests run without TPU hardware (the driver separately dry-runs multichip).
+
+Must run before any ``import jax`` in test modules — pytest imports conftest
+first, so setting the env here is sufficient.
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

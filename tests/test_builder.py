@@ -1,0 +1,101 @@
+"""Seed-sweep Builder + @sim_test decorator tests
+(mirrors ref sim/runtime/builder.rs behavior)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.builder import Builder
+
+
+def test_builder_runs_count_seeds():
+    seeds = []
+
+    async def test_body():
+        seeds.append(ms.current_handle().seed)
+
+    Builder(seed=100, count=5).run(test_body)
+    assert seeds == [100, 101, 102, 103, 104]
+
+
+def test_builder_env_parsing(monkeypatch):
+    monkeypatch.setenv("MADSIM_TEST_SEED", "77")
+    monkeypatch.setenv("MADSIM_TEST_NUM", "3")
+    monkeypatch.setenv("MADSIM_TEST_JOBS", "2")
+    b = Builder.from_env()
+    assert b.seed == 77
+    assert b.count == 3
+    assert b.jobs == 2
+
+
+def test_builder_prints_failing_seed(capsys):
+    async def failing():
+        if ms.current_handle().seed == 202:
+            raise AssertionError("seed-specific failure")
+
+    with pytest.raises(AssertionError):
+        Builder(seed=200, count=5).run(failing)
+    err = capsys.readouterr().err
+    assert "MADSIM_TEST_SEED=202" in err
+
+
+def test_builder_parallel_jobs():
+    seeds = []
+    import threading
+
+    lock = threading.Lock()
+
+    async def body():
+        with lock:
+            seeds.append(ms.current_handle().seed)
+
+    Builder(seed=300, count=8, jobs=4).run(body)
+    assert sorted(seeds) == list(range(300, 308))
+
+
+def test_sim_test_decorator():
+    ran = []
+
+    @ms.sim_test(seed=42, count=2)
+    async def my_test():
+        ran.append(ms.current_handle().seed)
+
+    my_test()
+    assert ran == [42, 43]
+
+
+def test_sim_test_check_determinism():
+    @ms.sim_test(seed=1, check_determinism=True)
+    async def my_test():
+        import random
+
+        await ms.sleep(random.uniform(0.01, 0.1))
+
+    my_test()
+
+
+def test_builder_time_limit():
+    from madsim_tpu.task import TimeLimitError
+
+    async def forever():
+        await ms.sleep(1e6)
+
+    with pytest.raises(TimeLimitError):
+        Builder(seed=1, time_limit=10.0).run(forever)
+
+
+def test_config_toml_roundtrip():
+    from madsim_tpu.config import Config
+
+    cfg = Config.from_toml(
+        """
+[net]
+packet_loss_rate = 0.1
+send_latency = [0.002, 0.02]
+"""
+    )
+    assert cfg.net.packet_loss_rate == 0.1
+    assert cfg.net.send_latency == (0.002, 0.02)
+    assert cfg.hash() == Config.from_toml(
+        "[net]\npacket_loss_rate = 0.1\nsend_latency = [0.002, 0.02]\n"
+    ).hash()
+    assert cfg.hash() != Config().hash()

@@ -1,0 +1,228 @@
+"""Filesystem sim tests (mirrors ref sim/fs.rs:259-296)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import fs
+
+
+def test_file_write_read():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("db").build()
+
+        async def body():
+            f = await fs.File.create("/data/log")
+            await f.write_all(b"hello ")
+            await f.write_all(b"world")
+            await f.sync_all()
+            assert await fs.read("/data/log") == b"hello world"
+            await f.write_all_at(b"WORLD", 6)
+            await f.sync_all()
+            assert await fs.read("/data/log") == b"hello WORLD"
+            meta = await fs.metadata("/data/log")
+            assert meta.len() == 11
+
+        await node.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_file_not_found():
+    rt = ms.Runtime(seed=2)
+
+    async def main():
+        node = ms.current_handle().create_node().build()
+
+        async def body():
+            with pytest.raises(FileNotFoundError):
+                await fs.File.open("/missing")
+
+        await node.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_fs_is_per_node():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().build()
+        n2 = h.create_node().build()
+
+        async def writer():
+            await fs.write("/shared", b"n1-data")
+
+        async def reader():
+            with pytest.raises(FileNotFoundError):
+                await fs.read("/shared")
+
+        await n1.spawn(writer())
+        await n2.spawn(reader())
+
+    rt.block_on(main())
+
+
+def test_power_fail_drops_unsynced_writes():
+    rt = ms.Runtime(seed=4)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("crashy").build()
+
+        async def write_phase():
+            f = await fs.File.create("/wal")
+            await f.write_all(b"synced")
+            await f.sync_all()
+            await f.write_all(b"+unsynced")
+            # no sync before crash
+
+        await node.spawn(write_phase())
+        h.restart(node)  # triggers FsSim.power_fail via reset_node
+
+        async def read_phase():
+            return await fs.read("/wal")
+
+        await ms.sleep(0.1)
+        assert await node.spawn(read_phase()) == b"synced"
+
+    rt.block_on(main())
+
+
+def test_set_len_and_read_at():
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        node = ms.current_handle().create_node().build()
+
+        async def body():
+            f = await fs.File.create("/f")
+            await f.write_all(b"0123456789")
+            assert await f.read_at(4, 3) == b"3456"
+            await f.set_len(5)
+            assert await f.read_all() == b"01234"
+            await f.set_len(8)
+            assert await f.read_all() == b"01234\x00\x00\x00"
+
+        await node.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_remove_file():
+    rt = ms.Runtime(seed=6)
+
+    async def main():
+        node = ms.current_handle().create_node().build()
+
+        async def body():
+            await fs.write("/tmp1", b"x")
+            await fs.remove_file("/tmp1")
+            with pytest.raises(FileNotFoundError):
+                await fs.read("/tmp1")
+
+        await node.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_unsynced_create_vanishes_on_power_fail():
+    rt = ms.Runtime(seed=7)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().build()
+
+        async def create_unsynced():
+            f = await fs.File.create("/ephemeral")
+            await f.write_all(b"gone")
+            # no sync
+
+        await node.spawn(create_unsynced())
+        h.restart(node)
+        await ms.sleep(0.1)
+
+        async def check():
+            with pytest.raises(FileNotFoundError):
+                await fs.read("/ephemeral")
+
+        await node.spawn(check())
+
+    rt.block_on(main())
+
+
+def test_create_over_existing_preserves_synced_until_sync():
+    rt = ms.Runtime(seed=8)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().build()
+
+        async def phase1():
+            await fs.write("/cfg", b"durable")
+            f = await fs.File.create("/cfg")  # truncate, buffered
+            await f.write_all(b"partial")
+            # crash before sync
+
+        await node.spawn(phase1())
+        h.restart(node)
+        await ms.sleep(0.1)
+
+        async def phase2():
+            return await fs.read("/cfg")
+
+        assert await node.spawn(phase2()) == b"durable"
+
+    rt.block_on(main())
+
+
+def test_unsynced_remove_resurrected_on_power_fail():
+    rt = ms.Runtime(seed=9)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().build()
+
+        async def phase1():
+            await fs.write("/keep", b"data")
+            await fs.remove_file("/keep")  # buffered unlink
+            with pytest.raises(FileNotFoundError):
+                await fs.read("/keep")
+
+        await node.spawn(phase1())
+        h.restart(node)
+        await ms.sleep(0.1)
+
+        async def phase2():
+            return await fs.read("/keep")
+
+        assert await node.spawn(phase2()) == b"data"
+
+    rt.block_on(main())
+
+
+def test_durable_remove_survives_power_fail():
+    rt = ms.Runtime(seed=10)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().build()
+
+        async def phase1():
+            await fs.write("/gone", b"data")
+            await fs.remove_file("/gone", durable=True)
+
+        await node.spawn(phase1())
+        h.restart(node)
+        await ms.sleep(0.1)
+
+        async def phase2():
+            with pytest.raises(FileNotFoundError):
+                await fs.read("/gone")
+
+        await node.spawn(phase2())
+
+    rt.block_on(main())

@@ -1,0 +1,568 @@
+"""Network simulator tests (mirrors ref sim/net/endpoint.rs:365-585,
+net/tcp/mod.rs:57-308, net/addr.rs:362-409, net/ipvs.rs:108-131)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.config import Config, NetConfig
+from madsim_tpu.net import (
+    Endpoint,
+    NetSim,
+    Request,
+    ServiceAddr,
+    TcpListener,
+    TcpStream,
+    UdpSocket,
+    lookup_host,
+)
+from madsim_tpu.plugin import simulator
+
+
+def two_nodes(h):
+    n1 = h.create_node().name("n1").ip("10.0.1.1").build()
+    n2 = h.create_node().name("n2").ip("10.0.1.2").build()
+    return n1, n2
+
+
+def test_endpoint_send_recv_across_nodes():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:100")
+            data, src = await ep.recv_from(42)
+            assert data == b"ping"
+            await ep.send_to(src, 43, b"pong")
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)  # let the server bind
+            await ep.send_to("10.0.1.2:100", 42, b"ping")
+            data, src = await ep.recv_from(43)
+            assert data == b"pong"
+            assert src[0] == "10.0.1.2"
+            return True
+
+        n2.spawn(server())
+        hc = n1.spawn(client())
+        assert await hc
+
+    rt.block_on(main())
+
+
+def test_endpoint_localhost_loopback():
+    rt = ms.Runtime(seed=2)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("solo").ip("10.0.9.1").build()
+
+        async def body():
+            a = await Endpoint.bind("127.0.0.1:200")
+            b = await Endpoint.bind("0.0.0.0:0")
+            await b.send_to("127.0.0.1:200", 7, b"local")
+            data, _ = await a.recv_from(7)
+            return data
+
+        assert await node.spawn(body()) == b"local"
+
+    rt.block_on(main())
+
+
+def test_tag_matching_mailbox():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().ip("10.2.0.1").build()
+
+        async def body():
+            ep = await Endpoint.bind("10.2.0.1:300")
+            tx = await Endpoint.bind("0.0.0.0:0")
+            # send tags out of order; recv must match by tag
+            await tx.send_to("10.2.0.1:300", 2, b"two")
+            await tx.send_to("10.2.0.1:300", 1, b"one")
+            d1, _ = await ep.recv_from(1)
+            d2, _ = await ep.recv_from(2)
+            return d1, d2
+
+        assert await node.spawn(body()) == (b"one", b"two")
+
+    rt.block_on(main())
+
+
+def test_dns_and_lookup_host():
+    rt = ms.Runtime(seed=4)
+
+    async def main():
+        net = simulator(NetSim)
+        h = ms.current_handle()
+        n1 = h.create_node().ip("10.3.0.1").build()
+        net.add_dns_record("server.example", "10.3.0.1")
+
+        async def body():
+            addrs = await lookup_host("server.example:80")
+            assert addrs == [("10.3.0.1", 80)]
+            addrs = await lookup_host("localhost:1")
+            assert addrs == [("127.0.0.1", 1)]
+
+        await n1.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_packet_loss_drops_messages():
+    cfg = Config(net=NetConfig(packet_loss_rate=1.0))
+    rt = ms.Runtime(seed=5, config=cfg)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:100")
+            await ep.recv_from(1)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            await ep.send_to("10.0.1.2:100", 1, b"lost")
+
+        hs = n2.spawn(server())
+        await n1.spawn(client())
+        with pytest.raises(ms.TimeoutError):
+            await ms.timeout(10.0, hs)
+
+    rt.block_on(main())
+
+
+def test_clog_node_blocks_then_unclog_delivers():
+    rt = ms.Runtime(seed=6)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+        got = []
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:100")
+            data, _ = await ep.recv_from(1)
+            got.append(data)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            stream_s, stream_r = None, None
+            # use a reliable channel so the clog delays rather than drops
+            sender, receiver = await ep.connect1("10.0.1.2:200")
+            await sender.send(b"queued")
+            return receiver
+
+        async def chan_server():
+            ep = await Endpoint.bind("10.0.1.2:200")
+            s, r, _src = await ep.accept1()
+            msg = await r.recv()
+            got.append(msg)
+
+        n2.spawn(server())
+        hcs = n2.spawn(chan_server())
+        net.clog_node(n2.id)
+        n1.spawn(client())
+        await ms.sleep(5.0)
+        assert got == []  # clogged: nothing arrives
+        net.unclog_node(n2.id)
+        await ms.timeout(30.0, hcs)
+        assert got == [b"queued"]
+
+    rt.block_on(main())
+
+
+def test_clog_link_directional():
+    rt = ms.Runtime(seed=7)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:100")
+            while True:
+                data, src = await ep.recv_from(1)
+                await ep.send_to(src, 2, b"ack:" + data)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            await ep.send_to("10.0.1.2:100", 1, b"m1")
+            data, _ = await ep.recv_from(2)
+            assert data == b"ack:m1"
+            # now clog only n1->n2; replies still flow but requests don't
+            net.clog_link(n1.id, n2.id)
+            await ep.send_to("10.0.1.2:100", 1, b"m2")
+            try:
+                await ms.timeout(5.0, ep.recv_from(2))
+                raise AssertionError("request should have been dropped")
+            except ms.TimeoutError:
+                pass
+
+        n2.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_ipvs_round_robin():
+    rt = ms.Runtime(seed=8)
+
+    async def main():
+        net = simulator(NetSim)
+        ipvs = net.global_ipvs()
+        svc = ServiceAddr.udp("10.99.0.1:80")
+        ipvs.add_service(svc)
+        ipvs.add_server(svc, "10.4.0.1:80")
+        ipvs.add_server(svc, "10.4.0.2:80")
+
+        h = ms.current_handle()
+        b1 = h.create_node().ip("10.4.0.1").build()
+        b2 = h.create_node().ip("10.4.0.2").build()
+        client = h.create_node().ip("10.4.0.9").build()
+        hits = {"b1": 0, "b2": 0}
+
+        async def backend(name, ip):
+            ep = await Endpoint.bind(f"{ip}:80")
+            while True:
+                await ep.recv_from(1)
+                hits[name] += 1
+
+        async def send_all():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            for _ in range(10):
+                await ep.send_to("10.99.0.1:80", 1, b"x")
+            await ms.sleep(1.0)
+
+        b1.spawn(backend("b1", "10.4.0.1"))
+        b2.spawn(backend("b2", "10.4.0.2"))
+        await client.spawn(send_all())
+        assert hits["b1"] == 5
+        assert hits["b2"] == 5
+
+    rt.block_on(main())
+
+
+def test_rpc_call_and_handler():
+    class Ping(Request):
+        def __init__(self, n):
+            self.n = n
+
+    rt = ms.Runtime(seed=9)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:500")
+
+            async def handle(req):
+                return req.n + 1
+
+            ep.add_rpc_handler(Ping, handle)
+            await ms.sleep(10_000.0)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            rsp = await ep.call("10.0.1.2:500", Ping(41))
+            assert rsp == 42
+            rsp = await ep.call_timeout("10.0.1.2:500", Ping(1), 5.0)
+            assert rsp == 2
+
+        n2.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_rpc_drop_hook():
+    class Ping(Request):
+        def __init__(self, n):
+            self.n = n
+
+    rt = ms.Runtime(seed=10)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+        net.hook_rpc_req(lambda src, dst, tag, payload: True)  # drop all reqs
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:500")
+
+            async def handle(req):
+                return req.n
+
+            ep.add_rpc_handler(Ping, handle)
+            await ms.sleep(10_000.0)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            with pytest.raises(ms.TimeoutError):
+                await ep.call_timeout("10.0.1.2:500", Ping(1), 5.0)
+
+        n2.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_tcp_echo():
+    rt = ms.Runtime(seed=11)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:700")
+            stream, peer = await listener.accept()
+            data = await stream.read_exact(5)
+            await stream.write_all_flush(b"echo:" + data)
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await TcpStream.connect("10.0.1.2:700")
+            await stream.write_all_flush(b"hello")
+            return await stream.read_exact(10)
+
+        n2.spawn(server())
+        assert await n1.spawn(client()) == b"echo:hello"
+
+    rt.block_on(main())
+
+
+def test_tcp_eof_on_close():
+    rt = ms.Runtime(seed=12)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:700")
+            stream, _ = await listener.accept()
+            await stream.write_all_flush(b"bye")
+            stream.shutdown()
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await TcpStream.connect("10.0.1.2:700")
+            assert await stream.read_exact(3) == b"bye"
+            assert await stream.read(10) == b""  # EOF
+
+        n2.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_tcp_connection_refused():
+    rt = ms.Runtime(seed=13)
+
+    async def main():
+        h = ms.current_handle()
+        n1, _n2 = two_nodes(h)
+
+        async def client():
+            with pytest.raises(ConnectionRefusedError):
+                await TcpStream.connect("10.0.1.2:999")
+
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_kill_server_breaks_connection():
+    rt = ms.Runtime(seed=14)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:700")
+            stream, _ = await listener.accept()
+            await stream.write_all_flush(b"hi")
+            await ms.sleep(10_000.0)
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await TcpStream.connect("10.0.1.2:700")
+            assert await stream.read_exact(2) == b"hi"
+            await ms.sleep(1.0)  # server gets killed here
+            with pytest.raises(ConnectionResetError):
+                while True:
+                    data = await stream.read(10)
+                    if data == b"":
+                        raise ConnectionResetError("eof")
+
+        n2.spawn(server())
+        hc = n1.spawn(client())
+        await ms.sleep(0.5)
+        h.kill(n2)
+        await hc
+
+    rt.block_on(main())
+
+
+def test_udp_socket():
+    rt = ms.Runtime(seed=15)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            sock = await UdpSocket.bind("10.0.1.2:800")
+            data, src = await sock.recv_from()
+            await sock.send_to(b"pong:" + data, src)
+
+        async def client():
+            sock = await UdpSocket.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            await sock.send_to(b"ping", "10.0.1.2:800")
+            data, _ = await sock.recv_from()
+            return data
+
+        n2.spawn(server())
+        assert await n1.spawn(client()) == b"pong:ping"
+
+    rt.block_on(main())
+
+
+def test_bind_ephemeral_and_conflict():
+    rt = ms.Runtime(seed=16)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().ip("10.5.0.1").build()
+
+        async def body():
+            a = await Endpoint.bind("10.5.0.1:0")
+            assert a.local_addr()[1] >= 32768
+            b = await Endpoint.bind("10.5.0.1:9000")
+            with pytest.raises(OSError, match="in use"):
+                await Endpoint.bind("10.5.0.1:9000")
+            b.close()
+            await Endpoint.bind("10.5.0.1:9000")  # rebind after close
+
+        await node.spawn(body())
+
+    rt.block_on(main())
+
+
+def test_reset_node_frees_ports():
+    rt = ms.Runtime(seed=17)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().ip("10.6.0.1").build()
+
+        async def body():
+            await Endpoint.bind("10.6.0.1:9000")
+            await ms.sleep(10_000.0)
+
+        node.spawn(body())
+        await ms.sleep(0.1)
+        h.restart(node)
+
+        async def rebind():
+            await Endpoint.bind("10.6.0.1:9000")
+
+        await ms.sleep(0.1)
+        await node.spawn(rebind())
+
+    rt.block_on(main())
+
+
+def test_net_stat_counts_messages():
+    rt = ms.Runtime(seed=18)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            ep = await Endpoint.bind("10.0.1.2:100")
+            while True:
+                await ep.recv_from(1)
+
+        async def client():
+            ep = await Endpoint.bind("0.0.0.0:0")
+            await ms.sleep(0.1)
+            for _ in range(3):
+                await ep.send_to("10.0.1.2:100", 1, b"x")
+            await ms.sleep(1.0)
+
+        n2.spawn(server())
+        await n1.spawn(client())
+        assert net.stat().msg_count >= 3
+
+    rt.block_on(main())
+
+
+def test_auto_ip_no_collision_with_user_ips():
+    rt = ms.Runtime(seed=19)
+
+    async def main():
+        h = ms.current_handle()
+        # claim an address in the auto-assign range, then force auto-assign
+        h.create_node().ip("10.200.0.2").build()
+        auto = h.create_node().build()  # id 2 would auto-map into 10.200.x
+        from madsim_tpu.plugin import simulator
+
+        ip = simulator(NetSim).get_ip(auto.id)
+        assert ip is not None and ip != "10.200.0.2"
+
+    rt.block_on(main())
+
+
+def test_finished_connections_unregister():
+    rt = ms.Runtime(seed=20)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:700")
+            while True:
+                stream, _ = await listener.accept()
+                while await stream.read(100):
+                    pass  # drain to EOF
+                stream.close()
+
+        async def client_once():
+            stream = await TcpStream.connect("10.0.1.2:700")
+            await stream.write_all_flush(b"x")
+            stream.close()
+            await ms.sleep(0.5)
+
+        n2.spawn(server())
+        await ms.sleep(0.1)
+        for _ in range(10):
+            await n1.spawn(client_once())
+        await ms.sleep(2.0)
+        # closed+drained pipes must not accumulate forever
+        assert len(net._node_pipes[n1.id]) + len(net._node_pipes[n2.id]) < 30
+
+    rt.block_on(main())

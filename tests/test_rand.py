@@ -1,0 +1,150 @@
+"""Determinism core tests (mirrors ref sim/rand.rs:262-331 and the
+determinism-check driver runtime/mod.rs:178-202)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.rand import GlobalRng, NondeterminismError, mix64
+
+
+def test_global_rng_reproducible():
+    a = GlobalRng(seed=123)
+    b = GlobalRng(seed=123)
+    assert [a.next_u64() for _ in range(10)] == [b.next_u64() for _ in range(10)]
+    c = GlobalRng(seed=124)
+    assert a.next_u64() != c.next_u64()
+
+
+def test_gen_range_bounds():
+    rng = GlobalRng(seed=1)
+    for _ in range(1000):
+        v = rng.gen_range(10, 20)
+        assert 10 <= v < 20
+    with pytest.raises(ValueError):
+        rng.gen_range(5, 5)
+
+
+def test_mix64_stable():
+    assert mix64(0) == mix64(0)
+    assert mix64(1) != mix64(2)
+
+
+def test_stdlib_random_interposed_deterministic():
+    """random.random() inside the sim is seeded (the getrandom analogue,
+    ref rand.rs:197-241)."""
+
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+
+        async def main():
+            import random
+            import uuid
+
+            return (
+                random.random(),
+                random.randint(0, 1000),
+                str(uuid.uuid4()),
+            )
+
+        return rt.block_on(main())
+
+    assert run(1) == run(1)
+    assert run(1) != run(2)
+
+
+def test_stdlib_time_interposed():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        import time as stdtime
+
+        t0 = stdtime.monotonic()
+        await ms.sleep(5.0)
+        return stdtime.monotonic() - t0
+
+    dt = rt.block_on(main())
+    assert 5.0 <= dt < 5.01  # virtual, not wall time
+
+
+def test_interpose_restored_outside_sim():
+    import random
+    import time as stdtime
+
+    rt = ms.Runtime(seed=4)
+
+    async def main():
+        pass
+
+    rt.block_on(main())
+    # outside the sim the real functions are back
+    assert stdtime.time() > 1_700_000_000  # actual wall clock (>2023)
+    random.seed(99)
+    x = random.random()
+    random.seed(99)
+    assert random.random() == x
+
+
+def test_thread_spawn_blocked_in_sim():
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        import threading
+
+        t = threading.Thread(target=lambda: None)
+        with pytest.raises(RuntimeError, match="deterministic"):
+            t.start()
+
+    rt.block_on(main())
+
+
+def test_check_determinism_passes_for_deterministic_workload():
+    async def workload():
+        import random
+
+        total = 0.0
+        for _ in range(10):
+            await ms.sleep(random.uniform(0.001, 0.1))
+            total += random.random()
+        return total
+
+    ms.Runtime.check_determinism(42, workload)
+
+
+def test_check_determinism_catches_wall_clock_leak():
+    state = {"runs": 0}
+
+    async def workload():
+        state["runs"] += 1
+        # leak real nondeterminism into the control flow on the 2nd run
+        n = 3 if state["runs"] == 1 else 5
+        for _ in range(n):
+            ms.rand.random()
+
+    with pytest.raises(NondeterminismError):
+        ms.Runtime.check_determinism(7, workload)
+
+
+def test_buggify_default_off_and_distribution():
+    rt = ms.Runtime(seed=8)
+
+    async def main():
+        assert not ms.buggify.is_enabled()
+        assert not ms.buggify.buggify()
+        ms.buggify.enable()
+        hits = sum(ms.buggify.buggify() for _ in range(2000))
+        # 25% nominal (ref buggify.rs:8-20)
+        assert 400 < hits < 600
+        ms.buggify.disable()
+        assert not ms.buggify.buggify()
+
+    rt.block_on(main())
+
+
+def test_seed_is_exposed():
+    rt = ms.Runtime(seed=31337)
+    assert rt.seed == 31337
+
+    async def main():
+        return ms.current_handle().seed
+
+    assert rt.block_on(main()) == 31337

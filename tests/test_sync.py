@@ -1,0 +1,208 @@
+"""Sync primitive tests (tokio-sync surface, kept native in this build)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import sync
+
+
+def run(seed, main_fn):
+    return ms.Runtime(seed=seed).block_on(main_fn())
+
+
+def test_oneshot():
+    async def main():
+        tx, rx = sync.oneshot()
+
+        async def sender():
+            await ms.sleep(0.01)
+            tx.send(42)
+
+        ms.spawn(sender())
+        assert await rx == 42
+
+    run(1, main)
+
+
+def test_mpsc_unbounded():
+    async def main():
+        tx, rx = sync.unbounded_channel()
+
+        async def producer():
+            for i in range(5):
+                tx.send_nowait(i)
+                await ms.sleep(0.001)
+            tx.close()
+
+        ms.spawn(producer())
+        got = []
+        while True:
+            v = await rx.recv()
+            if v is None:
+                break
+            got.append(v)
+        assert got == [0, 1, 2, 3, 4]
+
+    run(2, main)
+
+
+def test_mpsc_bounded_backpressure():
+    async def main():
+        tx, rx = sync.channel(2)
+        sent = []
+
+        async def producer():
+            for i in range(6):
+                await tx.send(i)
+                sent.append(i)
+            tx.close()
+
+        ms.spawn(producer())
+        await ms.sleep(0.01)
+        assert len(sent) <= 3  # capacity 2 (+1 in flight at most)
+        got = []
+        while True:
+            v = await rx.recv()
+            if v is None:
+                break
+            got.append(v)
+        assert got == list(range(6))
+
+    run(3, main)
+
+
+def test_watch():
+    async def main():
+        tx, rx = sync.watch("init")
+        seen = []
+
+        async def watcher():
+            while True:
+                await rx.changed()
+                v = rx.borrow_and_update()
+                seen.append(v)
+                if v == "done":
+                    return
+
+        h = ms.spawn(watcher())
+        await ms.sleep(0.01)
+        tx.send("a")
+        await ms.sleep(0.01)
+        tx.send("done")
+        await h
+        assert seen == ["a", "done"]
+
+    run(4, main)
+
+
+def test_broadcast():
+    async def main():
+        tx, rx1 = sync.broadcast(16)
+        rx2 = tx.subscribe()
+        tx.send("x")
+        tx.send("y")
+        assert await rx1.recv() == "x"
+        assert await rx2.recv() == "x"
+        assert await rx1.recv() == "y"
+        assert await rx2.recv() == "y"
+
+    run(5, main)
+
+
+def test_broadcast_lagged():
+    async def main():
+        tx, rx = sync.broadcast(2)
+        for i in range(5):
+            tx.send(i)
+        with pytest.raises(sync.LaggedError):
+            await rx.recv()
+        assert await rx.recv() == 3
+
+    run(6, main)
+
+
+def test_notify():
+    async def main():
+        n = sync.Notify()
+        woke = []
+
+        async def waiter():
+            await n.notified()
+            woke.append(1)
+
+        ms.spawn(waiter())
+        await ms.sleep(0.01)
+        n.notify_one()
+        await ms.sleep(0.01)
+        assert woke == [1]
+        # permit stored when no waiter
+        n.notify_one()
+        await n.notified()  # consumes stored permit without blocking
+
+    run(7, main)
+
+
+def test_mutex_exclusive():
+    async def main():
+        m = sync.Mutex()
+        log = []
+
+        async def critical(name):
+            async with m:
+                log.append(f"{name}-in")
+                await ms.sleep(0.01)
+                log.append(f"{name}-out")
+
+        hs = [ms.spawn(critical(i)) for i in range(3)]
+        for h in hs:
+            await h
+        # no interleaving inside the critical section
+        for i in range(0, 6, 2):
+            assert log[i].endswith("-in")
+            assert log[i + 1].split("-")[0] == log[i].split("-")[0]
+
+    run(8, main)
+
+
+def test_rwlock():
+    async def main():
+        lock = sync.RwLock()
+        r1 = await lock.read()
+        r2 = await lock.read()  # concurrent readers ok
+        r1.release()
+        r2.release()
+        w = await lock.write()
+        w.release()
+
+    run(9, main)
+
+
+def test_semaphore():
+    async def main():
+        sem = sync.Semaphore(2)
+        g1 = await sem.acquire()
+        g2 = await sem.acquire()
+        assert sem.try_acquire() is None
+        g1.release()
+        assert sem.try_acquire() is not None
+        g2.release()
+
+    run(10, main)
+
+
+def test_barrier():
+    async def main():
+        b = sync.Barrier(3)
+        results = []
+
+        async def party(i):
+            leader = await b.wait()
+            results.append((i, leader))
+
+        hs = [ms.spawn(party(i)) for i in range(3)]
+        for h in hs:
+            await h
+        assert len(results) == 3
+        assert sum(1 for _, leader in results if leader) == 1
+
+    run(11, main)

@@ -1,0 +1,355 @@
+"""Scheduler lifecycle battery (mirrors ref sim/task/mod.rs:787-1102 tests:
+kill / restart / restart_on_panic / pause / resume / ctrl-c / abort / exit,
+plus the randomized-schedule check: 10 seeds => multiple interleavings)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.futures import CancelledError
+from madsim_tpu.task import DeadlockError, TimeLimitError
+
+
+def test_spawn_and_join():
+    rt = ms.Runtime(seed=1)
+
+    async def child(x):
+        await ms.sleep(0.01)
+        return x * 2
+
+    async def main():
+        h = ms.spawn(child(21))
+        return await h
+
+    assert rt.block_on(main()) == 42
+
+
+def test_join_propagates_exception():
+    rt = ms.Runtime(seed=2)
+
+    async def boom():
+        raise ValueError("boom")
+
+    async def main():
+        h = ms.spawn(boom())
+        with pytest.raises(ValueError):
+            await h
+
+    # a panic without restart_on_panic aborts the simulation (ref resume_unwind)
+    with pytest.raises(ValueError):
+        rt.block_on(main())
+
+
+def test_abort_cancels_task():
+    rt = ms.Runtime(seed=3)
+    witness = []
+
+    async def victim():
+        try:
+            await ms.sleep(100.0)
+            witness.append("finished")
+        finally:
+            witness.append("cleanup")
+
+    async def main():
+        h = ms.spawn(victim())
+        await ms.sleep(0.01)
+        h.abort()
+        with pytest.raises(CancelledError):
+            await h
+
+    rt.block_on(main())
+    assert witness == ["cleanup"]  # finally ran, body did not complete
+
+
+def test_kill_node_drops_tasks():
+    rt = ms.Runtime(seed=4)
+    ticks = []
+
+    async def ticker():
+        while True:
+            await ms.sleep(1.0)
+            ticks.append(ms.time.elapsed())
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("worker").build()
+        node.spawn(ticker())
+        await ms.sleep(3.5)
+        h.kill(node)
+        n = len(ticks)
+        assert n == 3
+        await ms.sleep(5.0)
+        assert len(ticks) == n  # no more ticks after kill
+        assert h.is_exit(node)
+
+    rt.block_on(main())
+
+
+def test_spawn_on_killed_node_fails():
+    rt = ms.Runtime(seed=5)
+
+    async def noop():
+        pass
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("victim").build()
+        h.kill(node)
+        with pytest.raises(RuntimeError, match="killed"):
+            node.spawn(noop())
+
+    rt.block_on(main())
+
+
+def test_restart_reruns_init():
+    rt = ms.Runtime(seed=6)
+    boots = []
+
+    async def main():
+        h = ms.current_handle()
+
+        def init():
+            async def body():
+                boots.append(ms.time.elapsed())
+                await ms.sleep(10_000.0)
+
+            return body()
+
+        node = h.create_node().name("svc").init(init).build()
+        await ms.sleep(1.0)
+        assert len(boots) == 1
+        h.restart(node)
+        await ms.sleep(1.0)
+        assert len(boots) == 2
+
+    rt.block_on(main())
+
+
+def test_restart_on_panic():
+    rt = ms.Runtime(seed=7)
+    attempts = []
+
+    async def main():
+        h = ms.current_handle()
+
+        def init():
+            async def body():
+                attempts.append(1)
+                if len(attempts) < 3:
+                    raise RuntimeError("flaky service crash")
+                await ms.sleep(10_000.0)
+
+            return body()
+
+        h.create_node().name("flaky").init(init).restart_on_panic().build()
+        await ms.sleep(60.0)  # restart backoff is 1-10s per attempt
+        assert len(attempts) == 3
+
+    rt.block_on(main())
+
+
+def test_restart_on_panic_matching_filter():
+    rt = ms.Runtime(seed=8)
+    attempts = []
+
+    async def main():
+        h = ms.current_handle()
+
+        def init():
+            async def body():
+                attempts.append(1)
+                raise RuntimeError("unmatched kind of crash")
+
+            return body()
+
+        (
+            h.create_node()
+            .name("picky")
+            .init(init)
+            .restart_on_panic(matching="specific text")
+            .build()
+        )
+        await ms.sleep(30.0)
+
+    # crash text does not match the filter => panic propagates
+    with pytest.raises(RuntimeError, match="unmatched"):
+        rt.block_on(main())
+    assert len(attempts) == 1
+
+
+def test_pause_resume():
+    rt = ms.Runtime(seed=9)
+    ticks = []
+
+    async def ticker():
+        while True:
+            await ms.sleep(1.0)
+            ticks.append(1)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("pausable").build()
+        node.spawn(ticker())
+        await ms.sleep(2.5)
+        assert len(ticks) == 2
+        h.pause(node)
+        await ms.sleep(5.0)
+        assert len(ticks) == 2  # frozen while paused
+        h.resume(node)
+        await ms.sleep(2.1)
+        assert len(ticks) >= 4
+
+    rt.block_on(main())
+
+
+def test_ctrl_c_with_handler():
+    rt = ms.Runtime(seed=10)
+    got = []
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("graceful").build()
+
+        async def svc():
+            from madsim_tpu.signal import ctrl_c
+
+            await ctrl_c()
+            got.append("sigint")
+
+        node.spawn(svc())
+        await ms.sleep(1.0)
+        h.send_ctrl_c(node)
+        await ms.sleep(1.0)
+        assert got == ["sigint"]
+        assert not h.is_exit(node)  # handler installed => node survives
+
+    rt.block_on(main())
+
+
+def test_ctrl_c_without_handler_kills():
+    rt = ms.Runtime(seed=11)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("ungraceful").build()
+
+        async def svc():
+            await ms.sleep(10_000.0)
+
+        node.spawn(svc())
+        await ms.sleep(1.0)
+        h.send_ctrl_c(node)
+        assert h.is_exit(node)
+
+    rt.block_on(main())
+
+
+def test_randomized_schedule_distinct_interleavings():
+    """10 seeds must produce more than one distinct interleaving
+    (ref task/mod.rs:964-988)."""
+
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+        order = []
+
+        async def worker(i):
+            for _ in range(3):
+                await ms.sleep(0.001)
+                order.append(i)
+
+        async def main():
+            hs = [ms.spawn(worker(i)) for i in range(4)]
+            for h in hs:
+                await h
+
+        rt.block_on(main())
+        return tuple(order)
+
+    results = {run(seed) for seed in range(10)}
+    assert len(results) > 1
+
+
+def test_same_seed_same_interleaving():
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+        order = []
+
+        async def worker(i):
+            for _ in range(5):
+                await ms.sleep(0.001)
+                order.append(i)
+
+        async def main():
+            hs = [ms.spawn(worker(i)) for i in range(4)]
+            for h in hs:
+                await h
+
+        rt.block_on(main())
+        return tuple(order)
+
+    assert run(42) == run(42)
+
+
+def test_deadlock_detection():
+    rt = ms.Runtime(seed=12)
+
+    async def main():
+        from madsim_tpu.futures import Future
+
+        await Future()  # never resolved, no timers pending
+
+    with pytest.raises(DeadlockError):
+        rt.block_on(main())
+
+
+def test_time_limit():
+    rt = ms.Runtime(seed=13)
+    rt.set_time_limit(5.0)
+
+    async def main():
+        await ms.sleep(100.0)
+
+    with pytest.raises(TimeLimitError):
+        rt.block_on(main())
+
+
+def test_exit_current_task_kills_node():
+    rt = ms.Runtime(seed=14)
+    after = []
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("exiter").build()
+
+        async def svc():
+            await ms.sleep(1.0)
+            ms.exit_current_task()
+            after.append("unreachable")
+
+        node.spawn(svc())
+        await ms.sleep(2.0)
+        assert h.is_exit(node)
+        assert after == []
+
+    rt.block_on(main())
+
+
+def test_metrics():
+    rt = ms.Runtime(seed=15)
+
+    async def main():
+        h = ms.current_handle()
+        m = h.metrics()
+        assert m.num_nodes() >= 1
+
+        async def sleeper():
+            await ms.sleep(100.0)
+
+        ms.spawn(sleeper())
+        ms.spawn(sleeper())
+        await ms.sleep(0.01)
+        assert m.num_tasks() >= 2
+        by_node = m.num_tasks_by_node()
+        assert "main" in by_node
+
+    rt.block_on(main())

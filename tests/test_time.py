@@ -1,0 +1,143 @@
+"""Virtual time semantics (mirrors ref sim/time/mod.rs:232-280 tests)."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.time import MissedTickBehavior
+
+
+def test_sleep_advances_virtual_clock():
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        t0 = ms.time.now_instant()
+        await ms.sleep(1.0)
+        dt = ms.time.now_instant() - t0
+        assert 1.0 <= dt < 1.001  # epsilon + poll jitter only
+
+    rt.block_on(main())
+
+
+def test_sim_time_compression_is_instant():
+    # 1000 simulated seconds must run instantly in wall time
+    import time as walltime
+
+    rt = ms.Runtime(seed=2)
+
+    async def main():
+        await ms.sleep(1000.0)
+
+    start = walltime.monotonic()
+    rt.block_on(main())
+    assert walltime.monotonic() - start < 2.0
+
+
+def test_min_sleep_is_1ms():
+    rt = ms.Runtime(seed=3)
+
+    async def main():
+        t0 = ms.time.now_instant()
+        await ms.sleep(0.0)
+        assert ms.time.now_instant() - t0 >= 0.001
+
+    rt.block_on(main())
+
+
+def test_sleep_until_and_ordering():
+    rt = ms.Runtime(seed=4)
+    order = []
+
+    async def waiter(name, dur):
+        await ms.sleep(dur)
+        order.append(name)
+
+    async def main():
+        hs = [
+            ms.spawn(waiter("c", 3.0)),
+            ms.spawn(waiter("a", 1.0)),
+            ms.spawn(waiter("b", 2.0)),
+        ]
+        for h in hs:
+            await h
+
+    rt.block_on(main())
+    assert order == ["a", "b", "c"]
+
+
+def test_timeout_elapsed_and_ok():
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        with pytest.raises(ms.TimeoutError):
+            await ms.timeout(1.0, ms.sleep(10.0))
+        result = await ms.timeout(10.0, value_after(1.0))
+        assert result == 42
+
+    async def value_after(d):
+        await ms.sleep(d)
+        return 42
+
+    rt.block_on(main())
+
+
+def test_interval_burst_and_delay():
+    rt = ms.Runtime(seed=6)
+
+    async def main():
+        iv = ms.interval(1.0)
+        t0 = ms.time.now_instant()
+        await iv.tick()  # immediate first tick
+        assert ms.time.now_instant() - t0 < 0.01
+        await iv.tick()
+        assert 1.0 <= ms.time.now_instant() - t0 < 1.01
+
+        iv2 = ms.interval(1.0)
+        iv2.missed_tick_behavior = MissedTickBehavior.SKIP
+        await iv2.tick()
+        await ms.sleep(2.5)  # miss two ticks
+        await iv2.tick()  # skip should land on the next multiple
+
+    rt.block_on(main())
+
+
+def test_system_time_randomized_around_2022():
+    seen = set()
+    for seed in range(3):
+        rt = ms.Runtime(seed=seed)
+
+        async def main():
+            return ms.time.now()
+
+        wall = rt.block_on(main())
+        assert 1_640_000_000 < wall < 1_680_000_000  # within a year of 2022
+        seen.add(int(wall))
+    assert len(seen) > 1  # base time differs by seed
+
+
+def test_instant_same_seed_deterministic():
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+
+        async def main():
+            await ms.sleep(1.5)
+            return (ms.time.now_instant().ns, ms.time.now())
+
+        return rt.block_on(main())
+
+    assert run(7) == run(7)
+    assert run(7) != run(8)
+
+
+def test_manual_advance_fires_timers():
+    rt = ms.Runtime(seed=9)
+
+    async def main():
+        h = ms.spawn(sleeper())
+        ms.time.advance(10.0)
+        assert await h == "woke"
+
+    async def sleeper():
+        await ms.sleep(5.0)
+        return "woke"
+
+    rt.block_on(main())
