@@ -1,0 +1,83 @@
+"""Benchmark: MadRaft seed-sweep throughput, TPU engine vs host-tier CPU.
+
+Prints ONE JSON line:
+    {"metric": "madraft_sweep_seeds_per_sec", "value": N, "unit": "seeds/s",
+     "vs_baseline": M, ...}
+
+The workload is BASELINE.md config #3 (5-node Raft election with
+crash/restart fault injection, 3 virtual seconds per seed). The baseline is
+the host tier — this framework's own Python deterministic executor running
+the identical workload one seed at a time (the reference publishes no
+numbers, so the stage-1 CPU engine is the measured baseline per
+BASELINE.md). ``vs_baseline`` = device seeds/sec ÷ host seeds/sec.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time as walltime
+
+
+SIM_SECONDS = 3.0
+HOST_SEEDS = 8
+DEVICE_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+
+
+def bench_host() -> float:
+    """Host-tier executor: one full simulation per seed (seeds/sec)."""
+    sys.path.insert(0, __file__.rsplit("/", 1)[0] + "/examples")
+    from raft_host import run_seed
+
+    t0 = walltime.perf_counter()
+    for seed in range(HOST_SEEDS):
+        run_seed(seed, n=5, crashes=1, sim_seconds=SIM_SECONDS)
+    return HOST_SEEDS / (walltime.perf_counter() - t0)
+
+
+def bench_device() -> tuple:
+    """TPU engine: lockstep sweep (seeds/sec, excluding compile)."""
+    import jax
+    import jax.numpy as jnp
+
+    from madsim_tpu.engine import core
+    from madsim_tpu.models import raft
+
+    cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
+    wl = raft.workload(cfg)
+    seeds = jnp.arange(DEVICE_SEEDS, dtype=jnp.int64)
+
+    # warmup = compile (cached for the timed run)
+    jax.block_until_ready(core.run_sweep(wl, ecfg, seeds))
+    t0 = walltime.perf_counter()
+    final = core.run_sweep(wl, ecfg, seeds)
+    jax.block_until_ready(final)
+    dt = walltime.perf_counter() - t0
+    return DEVICE_SEEDS / dt, raft.sweep_summary(final), dt
+
+
+def main() -> None:
+    device_rate, summary, device_dt = bench_device()
+    host_rate = bench_host()
+    sim_ns_per_sec = summary["sim_ns_total"] / device_dt
+    print(
+        json.dumps(
+            {
+                "metric": "madraft_sweep_seeds_per_sec",
+                "value": round(device_rate, 2),
+                "unit": "seeds/s",
+                "vs_baseline": round(device_rate / host_rate, 3),
+                "baseline_host_seeds_per_sec": round(host_rate, 3),
+                "device_seeds": DEVICE_SEEDS,
+                "sim_seconds_per_wall_sec": round(sim_ns_per_sec / 1e9, 1),
+                "events_per_sec": round(summary["events_total"] / device_dt, 1),
+                "violations": summary["violations"],
+                "backend": __import__("jax").default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

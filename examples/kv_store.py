@@ -10,6 +10,10 @@ The analogue of the reference's examples/rpc.rs demo
 (/root/reference/madsim/examples/rpc.rs).
 """
 
+import sys
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
 import madsim_tpu as ms
 from madsim_tpu.net import Endpoint, NetSim, Request
 from madsim_tpu.plugin import simulator

@@ -1,0 +1,165 @@
+"""Host-tier Raft leader election — the MadRaft-style workload on the
+Python executor.
+
+This is the same workload as ``madsim_tpu.models.raft`` runs on the device
+engine, written the way a *user* of the framework writes it: ordinary async
+code on simulated nodes with Endpoint messaging, randomized election
+timers on virtual time, and supervisor-injected crash/restarts (the shape
+of the reference's tonic-example/etcd integration tests, SURVEY.md §4).
+
+It doubles as the CPU baseline for ``bench.py``: seeds/sec here (one
+Python-executor simulation per seed) vs seeds/sec of the lockstep TPU
+sweep.
+
+Run directly:  python examples/raft_host.py [seed]
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import madsim_tpu as ms
+from madsim_tpu.net import Endpoint
+
+FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
+TAG = 1
+PORT = 700
+
+ELECTION_LO = 0.150
+ELECTION_HI = 0.300
+HEARTBEAT = 0.050
+TICK = 0.010  # election-deadline poll granularity
+
+
+def _ip(i: int) -> str:
+    return f"10.0.0.{i + 1}"
+
+
+class _Node:
+    """Per-node volatile election state + message handlers."""
+
+    def __init__(self, i: int, n: int, stats: Dict):
+        self.i = i
+        self.n = n
+        self.stats = stats
+        self.role = FOLLOWER
+        self.term = 0
+        self.voted = -1
+        self.votes: set = set()
+        self.deadline = ms.time.now_instant() + ms.rand.uniform(ELECTION_LO, ELECTION_HI)
+
+    def _reset_deadline(self) -> None:
+        self.deadline = ms.time.now_instant() + ms.rand.uniform(ELECTION_LO, ELECTION_HI)
+
+    async def _broadcast(self, ep: Endpoint, msg: tuple) -> None:
+        for j in range(self.n):
+            if j != self.i:
+                await ep.send_to_raw((_ip(j), PORT), TAG, msg)
+                self.stats["msgs"] += 1
+
+    async def _become_leader(self, ep: Endpoint) -> None:
+        self.role = LEADER
+        self.stats["elections"].append((self.term, self.i))
+        for term, who in self.stats["elections"]:
+            if term == self.term and who != self.i:
+                self.stats["violations"] += 1
+        await self._broadcast(ep, ("ae", self.term, self.i))
+
+    async def handle(self, ep: Endpoint, msg: tuple) -> None:
+        kind, mterm, src = msg
+        if mterm > self.term:
+            self.term, self.role, self.voted = mterm, FOLLOWER, -1
+            self.votes = set()
+        if kind == "rv":
+            if mterm == self.term and self.voted in (-1, src):
+                self.voted = src
+                self._reset_deadline()
+                await ep.send_to_raw((_ip(src), PORT), TAG, ("vg", mterm, self.i))
+                self.stats["msgs"] += 1
+        elif kind == "vg":
+            if self.role == CANDIDATE and mterm == self.term:
+                self.votes.add(src)
+                if len(self.votes) >= self.n // 2 + 1:
+                    await self._become_leader(ep)
+        elif kind == "ae":
+            if mterm == self.term:
+                if self.role == CANDIDATE:
+                    self.role = FOLLOWER
+                self._reset_deadline()
+
+    async def receiver(self, ep: Endpoint) -> None:
+        while True:
+            msg, _src = await ep.recv_from_raw(TAG)
+            await self.handle(ep, msg)
+
+    async def ticker(self, ep: Endpoint) -> None:
+        """Election timer (poll) + leader heartbeats."""
+        while True:
+            if self.role == LEADER:
+                await ms.sleep(HEARTBEAT)
+                await self._broadcast(ep, ("ae", self.term, self.i))
+            else:
+                await ms.sleep(TICK)
+                if ms.time.now_instant() >= self.deadline:
+                    self.term += 1
+                    self.role = CANDIDATE
+                    self.voted = self.i
+                    self.votes = {self.i}
+                    self._reset_deadline()
+                    await self._broadcast(ep, ("rv", self.term, self.i))
+
+
+def _node_init(i: int, n: int, stats: Dict):
+    def make():
+        async def run():
+            node = _Node(i, n, stats)
+            ep = await Endpoint.bind((_ip(i), PORT))
+            ms.spawn(node.receiver(ep))
+            await node.ticker(ep)
+
+        return run()
+
+    return make
+
+
+async def _supervise(stats: Dict, n: int, crashes: int, sim_seconds: float) -> None:
+    h = ms.current_handle()
+    nodes: List = [
+        h.create_node().name(f"raft-{i}").ip(_ip(i)).init(_node_init(i, n, stats)).build()
+        for i in range(n)
+    ]
+    deadline = ms.time.now_instant() + sim_seconds
+    for _ in range(crashes):
+        at = ms.rand.uniform(0.0, sim_seconds / 2)
+        victim = nodes[ms.rand.gen_range(0, n)]
+        await ms.sleep(max(at - ms.time.elapsed(), 0.001))
+        h.kill(victim)
+        await ms.sleep(ms.rand.uniform(0.1, 1.0))
+        h.restart(victim)
+    remaining = deadline - ms.time.now_instant()
+    if remaining > 0:
+        await ms.sleep(remaining)
+
+
+def run_seed(
+    seed: int, n: int = 5, crashes: int = 1, sim_seconds: float = 3.0
+) -> Dict:
+    """One complete simulation; returns election stats for the seed."""
+    stats: Dict = {"elections": [], "violations": 0, "msgs": 0}
+    rt = ms.Runtime(seed=seed)
+    rt.block_on(_supervise(stats, n, crashes, sim_seconds))
+    stats["seed"] = seed
+    stats["leaders_elected"] = len(stats["elections"])
+    return stats
+
+
+if __name__ == "__main__":
+    seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1
+    out = run_seed(seed)
+    print(
+        f"seed={seed} elections={out['leaders_elected']} "
+        f"violations={out['violations']} msgs={out['msgs']}"
+    )

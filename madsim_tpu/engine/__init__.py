@@ -1,0 +1,56 @@
+"""TPU tier: the batched struct-of-arrays simulation engine.
+
+This is the re-design of the reference's inner simulation loop
+(pop-min-event / advance-clock / RNG-draw / deliver-message — see
+madsim/src/sim/task/mod.rs:220-317 and SURVEY.md §3.1) as a JAX engine that
+steps **thousands of seeds in lockstep**:
+
+- every piece of per-seed simulator state (virtual clock, event queue,
+  workload actor state, link-state network tables) is a leading-batch-axis
+  array (struct-of-arrays);
+- one jitted ``step`` pops the minimum-time event, advances the clock,
+  draws counter-based randomness keyed by ``(seed, event_index)`` and
+  dispatches to the workload's pure handler — vmapped over the seed batch;
+- seeds that finish are masked out (``done`` flag) so divergent control
+  flow never breaks lockstep;
+- everything is integer math (times are int64 nanoseconds, randomness is
+  threefry bits), so a sweep is **bit-exact across CPU and TPU backends**:
+  any failure found in a TPU batch replays byte-identically with
+  ``run_traced`` on CPU.
+
+Scale-out is pure data parallelism over seeds (SURVEY.md §2.3): shard the
+seed batch over a ``jax.sharding.Mesh`` — see ``madsim_tpu.parallel``.
+
+64-bit note: virtual time is int64 nanoseconds (the bit-exactness rule of
+SURVEY.md §7 forbids float time math), so importing this package enables
+``jax_enable_x64``. XLA:TPU emulates int64 with 32-bit pairs; the engine's
+hot comparisons are cheap relative to event dispatch.
+"""
+
+import jax
+
+jax.config.update("jax_enable_x64", True)
+
+from .core import (  # noqa: E402
+    EngineConfig,
+    EngineState,
+    Emits,
+    Workload,
+    init_sweep,
+    run_sweep,
+    run_traced,
+    step_batch,
+)
+from .queue import EventQueue  # noqa: E402
+
+__all__ = [
+    "EngineConfig",
+    "EngineState",
+    "Emits",
+    "EventQueue",
+    "Workload",
+    "init_sweep",
+    "run_sweep",
+    "run_traced",
+    "step_batch",
+]

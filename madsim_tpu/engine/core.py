@@ -1,0 +1,217 @@
+"""The batched simulation loop: pop-min / advance-clock / draw / dispatch.
+
+This is the reference's hot loop (``Executor::block_on`` →
+``advance_to_next_event``, SURVEY.md §3.1) restructured for lockstep
+execution over a seed batch:
+
+- ``step_one`` advances ONE seed by ONE event: pop the minimum-time event,
+  jump the virtual clock to it plus a random 50-100 ns jitter (the
+  amplification analogue of the reference's per-poll advance,
+  task/mod.rs:312-315 and +50 ns epsilon, time/mod.rs:45-60), draw
+  counter-based randomness, dispatch to the workload's pure handler, and
+  push the events it emits.
+- ``step_batch`` is ``vmap(step_one)``; finished seeds are masked (their
+  state passes through unchanged and their RNG counter freezes), so
+  divergent seeds never break lockstep.
+- ``run_sweep`` drives ``step_batch`` under ``lax.while_loop`` until every
+  seed is done (queue empty = the reference's deadlock condition,
+  task/mod.rs:250; or virtual time limit, task/mod.rs:253-258) — one XLA
+  program, no host round-trips.
+- ``run_traced`` replays a single seed recording every dispatched event —
+  the bit-exact CPU replay artifact (run it with JAX's CPU backend; the
+  engine is integer-only so the trace matches the TPU batch bit for bit).
+
+The workload is a pair of pure functions over arrays (actors as state
+machines), not coroutines: user futures can't run on TPU (SURVEY.md §7
+"hard parts" #1), so the device tier targets table-driven workloads
+(models/), while arbitrary user code runs on the host tier with the same
+simulation semantics.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import queue as equeue
+from .queue import EventQueue
+from .rng import bounded, event_bits, seed_key
+
+
+class Emits(NamedTuple):
+    """Fixed-size batch of events emitted by one handler invocation."""
+
+    times: jnp.ndarray  # int64[E] absolute deadlines
+    kinds: jnp.ndarray  # int32[E]
+    pays: jnp.ndarray  # int32[E, P]
+    enables: jnp.ndarray  # bool[E]
+
+
+def no_emits(max_emits: int, payload_slots: int) -> Emits:
+    return Emits(
+        times=jnp.zeros((max_emits,), jnp.int64),
+        kinds=jnp.zeros((max_emits,), jnp.int32),
+        pays=jnp.zeros((max_emits, payload_slots), jnp.int32),
+        enables=jnp.zeros((max_emits,), bool),
+    )
+
+
+class Workload(NamedTuple):
+    """A device-expressible workload: two pure functions + static sizes.
+
+    ``init(key) -> (wstate, Emits)`` builds the per-seed actor state and the
+    initial event set (timers, fault plan). ``handle(wstate, now_ns, kind,
+    pay, rand_u32) -> (wstate, Emits)`` processes one event; ``rand_u32``
+    is ``num_rand`` uint32 draws unique to this (seed, event) pair.
+    """
+
+    init: Callable[[jax.Array], Tuple[Any, Emits]]
+    handle: Callable[..., Tuple[Any, Emits]]
+    num_rand: int
+    payload_slots: int
+    max_emits: int
+
+
+class EngineConfig(NamedTuple):
+    """Static engine parameters (python ints — part of the jit cache key)."""
+
+    queue_capacity: int = 64
+    time_limit_ns: int = 10_000_000_000
+    max_steps: int = 100_000
+    jitter_lo_ns: int = 50
+    jitter_hi_ns: int = 100
+
+
+class EngineState(NamedTuple):
+    """Per-seed simulator state; ``run_sweep`` holds one with a leading
+    seed-batch axis on every leaf (struct-of-arrays)."""
+
+    seed: jnp.ndarray  # int64
+    key: jax.Array  # typed PRNG key
+    now_ns: jnp.ndarray  # int64 virtual clock
+    ctr: jnp.ndarray  # int32 events processed (RNG counter)
+    done: jnp.ndarray  # bool
+    overflow: jnp.ndarray  # bool sticky queue-overflow flag
+    queue: EventQueue
+    wstate: Any  # workload pytree
+
+
+def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> EngineState:
+    key = seed_key(seed)
+    wstate, emits = workload.init(key)
+    q = equeue.make(cfg.queue_capacity, workload.payload_slots)
+    q, overflow = equeue.push_many(q, emits.times, emits.kinds, emits.pays, emits.enables)
+    return EngineState(
+        seed=jnp.asarray(seed, jnp.int64),
+        key=key,
+        now_ns=jnp.zeros((), jnp.int64),
+        ctr=jnp.zeros((), jnp.int32),
+        done=jnp.zeros((), bool),
+        overflow=overflow,
+        queue=q,
+        wstate=wstate,
+    )
+
+
+def init_sweep(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
+    """Build the batched state for a seed vector (int64[S])."""
+    return jax.vmap(partial(_init_one, workload, cfg))(jnp.asarray(seeds, jnp.int64))
+
+
+def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineState:
+    """Advance one seed by one event (no-op once ``done``)."""
+    q, t, kind, pay, found = equeue.pop_min(s.queue)
+    rand = event_bits(s.key, s.ctr, workload.num_rand + 1)
+    jitter = bounded(rand[0], cfg.jitter_lo_ns, cfg.jitter_hi_ns + 1)
+    now = jnp.maximum(s.now_ns, t) + jitter
+    time_up = now > cfg.time_limit_ns
+    dispatch = found & ~time_up
+
+    wstate, emits = workload.handle(s.wstate, now, kind, pay, rand[1:])
+    q, ov = equeue.push_many(
+        q, emits.times, emits.kinds, emits.pays, emits.enables & dispatch
+    )
+
+    # Select between the advanced and untouched state. Three masks compose:
+    # already-done seeds freeze entirely; a popped-empty queue or expired
+    # clock marks done without dispatching; only `dispatch` applies the
+    # handler's writes.
+    active = ~s.done
+    take = active & dispatch
+
+    def sel(pred, new, old):
+        return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
+
+    return EngineState(
+        seed=s.seed,
+        key=s.key,
+        now_ns=jnp.where(take, now, s.now_ns),
+        ctr=jnp.where(take, s.ctr + 1, s.ctr),
+        done=s.done | (active & (~found | time_up)),
+        overflow=s.overflow | (take & ov),
+        queue=sel(take, q, s.queue),
+        wstate=sel(take, wstate, s.wstate),
+    )
+
+
+def step_batch(workload: Workload, cfg: EngineConfig, state: EngineState) -> EngineState:
+    """One lockstep event for every live seed in the batch."""
+    return jax.vmap(partial(step_one, workload, cfg))(state)
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
+    state = init_sweep(workload, cfg, seeds)
+
+    def cond(carry):
+        state, iters = carry
+        return jnp.any(~state.done) & (iters < cfg.max_steps)
+
+    def body(carry):
+        state, iters = carry
+        return step_batch(workload, cfg, state), iters + 1
+
+    state, _ = jax.lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
+    return state
+
+
+def run_sweep(workload: Workload, cfg: EngineConfig, seeds) -> EngineState:
+    """Run a whole seed batch to completion; returns the final batched
+    state (workload stats live in ``.wstate``)."""
+    return _run(workload, cfg, jnp.asarray(seeds, jnp.int64))
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray):
+    state = _init_one(workload, cfg, seed)
+
+    def scan_step(s, _):
+        before_ctr = s.ctr
+        q, t, kind, pay, found = equeue.pop_min(s.queue)
+        s2 = step_one(workload, cfg, s)
+        fired = s2.ctr > before_ctr
+        rec = (
+            jnp.where(fired, s2.now_ns, jnp.int64(-1)),
+            jnp.where(fired, kind, jnp.int32(-1)),
+            jnp.where(fired, pay, jnp.zeros_like(pay)),
+            fired,
+        )
+        return s2, rec
+
+    final, (times, kinds, pays, fired) = jax.lax.scan(
+        scan_step, state, None, length=cfg.max_steps
+    )
+    return final, {"time_ns": times, "kind": kinds, "pay": pays, "fired": fired}
+
+
+def run_traced(workload: Workload, cfg: EngineConfig, seed: int):
+    """Replay ONE seed, recording every dispatched event in order.
+
+    This is the debugging/bit-exact-replay path (SURVEY.md §7): run it on
+    the CPU backend against a failure seed found by a TPU sweep — the
+    integer-only engine guarantees the identical event sequence.
+    """
+    return _run_traced(workload, cfg, jnp.asarray(seed, jnp.int64))

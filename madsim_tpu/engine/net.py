@@ -1,0 +1,85 @@
+"""Link-state network model as per-seed arrays.
+
+The reference's ``Network`` (madsim/src/sim/net/network.rs:20-314) keeps
+clogged-node/link sets and draws per-message loss + latency
+(``test_link``, network.rs:261-269). Here the same model is data:
+
+    clog    : bool[N,N]   directed link clogged (row = src, col = dst);
+                          clogging a node = setting its row (out) / col (in)
+    loss_q32: uint32      packet-loss probability, Q0.32 fixed point
+    lat_lo/hi_ns          latency range, drawn uniformly per message
+                          (reference default 1-10 ms, network.rs:87-89)
+
+``route`` turns one (src, dst, two uint32 draws) into a delivery deadline +
+deliver flag — the whole decision is a handful of vector ops, evaluated for
+every in-flight message of every seed in lockstep.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax.numpy as jnp
+
+from .rng import bounded, coin
+
+
+class LinkState(NamedTuple):
+    clog: jnp.ndarray  # bool[N, N]
+    loss_q32: jnp.ndarray  # uint32 scalar
+    lat_lo_ns: jnp.ndarray  # int64 scalar
+    lat_hi_ns: jnp.ndarray  # int64 scalar
+
+
+def make(
+    num_nodes: int,
+    loss_q32: int = 0,
+    lat_lo_ns: int = 1_000_000,
+    lat_hi_ns: int = 10_000_000,
+) -> LinkState:
+    return LinkState(
+        clog=jnp.zeros((num_nodes, num_nodes), bool),
+        loss_q32=jnp.asarray(loss_q32, jnp.uint32),
+        lat_lo_ns=jnp.asarray(lat_lo_ns, jnp.int64),
+        lat_hi_ns=jnp.asarray(lat_hi_ns, jnp.int64),
+    )
+
+
+def route(
+    links: LinkState,
+    now_ns: jnp.ndarray,
+    src: jnp.ndarray,
+    dst: jnp.ndarray,
+    u_loss: jnp.ndarray,
+    u_lat: jnp.ndarray,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Per-message link test (ref ``test_link``): returns
+    ``(deliver_time_ns, deliver)`` — dropped when the directed link is
+    clogged or the loss draw fires."""
+    clogged = links.clog[src, dst]
+    lost = coin(u_loss, links.loss_q32)
+    latency = bounded(u_lat, links.lat_lo_ns, links.lat_hi_ns + 1)
+    return now_ns + latency, ~(clogged | lost)
+
+
+def clog_node(links: LinkState, node: jnp.ndarray) -> LinkState:
+    """Clog both directions of a node (ref ``NetSim::clog_node``)."""
+    n = links.clog.shape[0]
+    idx = jnp.arange(n)
+    mask = (idx[:, None] == node) | (idx[None, :] == node)
+    return links._replace(clog=links.clog | mask)
+
+
+def unclog_node(links: LinkState, node: jnp.ndarray) -> LinkState:
+    n = links.clog.shape[0]
+    idx = jnp.arange(n)
+    mask = (idx[:, None] == node) | (idx[None, :] == node)
+    return links._replace(clog=links.clog & ~mask)
+
+
+def clog_link(links: LinkState, src: jnp.ndarray, dst: jnp.ndarray) -> LinkState:
+    return links._replace(clog=links.clog.at[src, dst].set(True))
+
+
+def unclog_link(links: LinkState, src: jnp.ndarray, dst: jnp.ndarray) -> LinkState:
+    return links._replace(clog=links.clog.at[src, dst].set(False))

@@ -1,0 +1,60 @@
+"""Counter-based randomness for the device engine.
+
+The host tier's ``GlobalRng`` (madsim_tpu.rand) is a sequential stream — fine
+for one seed on one CPU, impossible to batch. The device engine instead keys
+every draw by ``(seed, event_counter)`` with threefry (`jax.random.fold_in`),
+the TPU-native analogue of the reference's single Xoshiro stream
+(madsim/src/sim/rand.rs:28-135): per seed, draw ``i`` is a pure function of
+``(seed, i)``, so replaying one seed on CPU consumes bit-identical
+randomness in any order and with any batch size.
+
+All helpers are integer-only (uint32 in, integer or fixed-point compare out)
+— no float rounding can diverge between backends.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+UINT32_SPAN = 1 << 32
+
+
+def seed_key(seed: jax.Array) -> jax.Array:
+    """Per-seed base PRNG key (uint32 typed key; int64-safe seed)."""
+    return jax.random.key(seed)
+
+
+def event_bits(key: jax.Array, ctr: jax.Array, n: int) -> jax.Array:
+    """``n`` uint32 draws for event number ``ctr`` of this seed.
+
+    Counter-based: (key, ctr) fully determines the draws — the device
+    analogue of the reference's "one RNG draw sequence per seed"
+    determinism contract (rand.rs:64-88).
+    """
+    return jax.random.bits(jax.random.fold_in(key, ctr), (n,), dtype=jnp.uint32)
+
+
+def bounded(u32: jax.Array, low, high) -> jax.Array:
+    """Map a uint32 draw to an integer in ``[low, high)``.
+
+    Lemire-style multiply-shift reduction — same formula as the host tier's
+    ``GlobalRng.gen_range`` so both tiers share bias characteristics.
+    Result dtype is int64 (times are int64 ns).
+    """
+    span = jnp.asarray(high, jnp.int64) - jnp.asarray(low, jnp.int64)
+    return jnp.asarray(low, jnp.int64) + (u32.astype(jnp.int64) * span >> 32)
+
+
+def coin(u32: jax.Array, prob_q32: jax.Array) -> jax.Array:
+    """Bernoulli from a uint32 draw against a Q0.32 fixed-point probability.
+
+    ``prob_q32 = round(p * 2**32)`` — comparing integers keeps the draw
+    bit-exact across backends (no float compare).
+    """
+    return u32.astype(jnp.uint32) < jnp.asarray(prob_q32, jnp.uint32)
+
+
+def prob_to_q32(p: float) -> int:
+    """Host-side: convert a float probability to Q0.32 fixed point."""
+    return min(UINT32_SPAN - 1, max(0, int(round(p * UINT32_SPAN))))

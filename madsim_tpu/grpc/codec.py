@@ -1,0 +1,62 @@
+"""Streaming message adapter (the tonic ``Streaming<T>`` analogue,
+madsim-tonic/src/codec.rs).
+
+Wire protocol (madsim-tonic/src/client.rs:33-38): stream bodies travel as
+raw messages on the connection; ``()`` — here ``EOS`` — marks end of
+stream; a mid-stream server error arrives as an ``("__status__", Status)``
+trailer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .status import Status
+
+EOS = ("__eos__",)  # end-of-stream marker (the reference's `()` trailer)
+ERR = "__status__"
+
+
+def is_eos(msg: Any) -> bool:
+    return isinstance(msg, tuple) and len(msg) == 1 and msg == EOS
+
+
+def is_err(msg: Any) -> bool:
+    return isinstance(msg, tuple) and len(msg) == 2 and msg[0] == ERR
+
+
+class Streaming:
+    """Async iterator over a stream of response messages.
+
+    ``async for msg in stream`` or ``await stream.message()`` (returns
+    ``None`` at end of stream — the tonic API shape).
+    """
+
+    def __init__(self, rx: Any):
+        self._rx = rx
+        self._done = False
+
+    async def message(self) -> Optional[Any]:
+        if self._done:
+            return None
+        try:
+            msg = await self._rx.recv()
+        except ConnectionResetError as e:
+            self._done = True
+            raise Status.unavailable(str(e) or "connection reset") from None
+        if msg is None or is_eos(msg):
+            self._done = True
+            return None
+        if is_err(msg):
+            self._done = True
+            raise msg[1]
+        return msg
+
+    def __aiter__(self) -> "Streaming":
+        return self
+
+    async def __anext__(self) -> Any:
+        msg = await self.message()
+        if msg is None:
+            raise StopAsyncIteration
+        return msg

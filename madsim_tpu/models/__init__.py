@@ -1,0 +1,12 @@
+"""Device-expressible workload models for the TPU engine.
+
+The reference drives arbitrary async Rust through its simulator; arbitrary
+user code cannot run on a TPU, so the device tier ships table-driven actor
+models of the canonical DST workloads (SURVEY.md §7 stage 6). The flagship
+is MadRaft-style Raft (models/raft.py) — the workload named by the
+BASELINE.md benchmark configs.
+"""
+
+from . import raft  # noqa: F401
+
+__all__ = ["raft"]

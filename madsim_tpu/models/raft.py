@@ -1,0 +1,447 @@
+"""Raft leader election as a device workload (the MadRaft sweep).
+
+This is the flagship model for the engine: an N-node Raft cluster (election
++ heartbeats, Ongaro & Ousterhout §5.2) with crash/restart fault injection
+and per-message loss/latency, expressed as pure array handlers so thousands
+of seeds run in lockstep on TPU. It plays the role the MadRaft test suite
+plays for the reference (BASELINE.md configs #3/#5): randomized schedules +
+faults hunting for election-safety violations, with every found seed
+replayable bit-exactly on CPU via ``engine.run_traced``.
+
+Mechanics mirrored from the reference simulator rather than any Raft
+implementation: message delivery = link test + latency draw
+(madsim/src/sim/net/network.rs:261-269), node crash/restart semantics =
+kill/restart with durable vs volatile state
+(madsim/src/sim/task/mod.rs:347-394), randomized timers = the virtual-clock
+timer queue (madsim/src/sim/time/mod.rs:142-153).
+
+Design notes:
+- Timer staleness uses generation counters (``tgen`` per node for election
+  timers, ``lepoch`` per node for heartbeat timers) instead of timer
+  cancellation — the queue is append-only per event, cancellation is a
+  pay-mismatch drop, which costs nothing in lockstep.
+- Election safety is checked online: every won election is recorded in a
+  small (term, node) ring; a second winner of an already-recorded term
+  raises the sticky ``violation`` flag.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import net as enet
+from ..engine.core import Emits, EngineConfig, Workload
+from ..engine.rng import bounded, prob_to_q32
+from ..engine.queue import INVALID_TIME
+
+# event kinds
+K_ELECTION = 0  # pay = (node, tgen)
+K_HEARTBEAT = 1  # pay = (node, lepoch)
+K_MSG = 2  # pay = (dst, mtype, src, term)
+K_CRASH = 3  # pay = (node,)
+K_RESTART = 4  # pay = (node,)
+
+# message types
+M_REQ_VOTE = 0
+M_VOTE_GRANT = 1
+M_APPEND = 2
+
+# roles
+FOLLOWER = 0
+CANDIDATE = 1
+LEADER = 2
+
+PAYLOAD_SLOTS = 4
+
+
+class RaftConfig(NamedTuple):
+    """Static sweep parameters (hashable — part of the jit key)."""
+
+    num_nodes: int = 5
+    election_lo_ns: int = 150_000_000
+    election_hi_ns: int = 300_000_000
+    heartbeat_ns: int = 50_000_000
+    # fault plan: `crashes` node-crash events at random times in the first
+    # `crash_window_ns`, each restarting after a random delay
+    crashes: int = 2
+    crash_window_ns: int = 5_000_000_000
+    restart_lo_ns: int = 100_000_000
+    restart_hi_ns: int = 1_000_000_000
+    # network model (reference defaults: 1-10 ms latency, lossless)
+    loss_q32: int = prob_to_q32(0.01)
+    lat_lo_ns: int = 1_000_000
+    lat_hi_ns: int = 10_000_000
+    history: int = 16  # election-safety ring size
+
+
+class RaftState(NamedTuple):
+    # per-node Raft state [N]
+    role: jnp.ndarray  # int32
+    term: jnp.ndarray  # int32
+    voted: jnp.ndarray  # int32, -1 = none (durable)
+    votes: jnp.ndarray  # uint32 bitmask of granted votes
+    alive: jnp.ndarray  # bool
+    last_hb: jnp.ndarray  # int64, last time a valid leader/grant was heard
+    tgen: jnp.ndarray  # int32 election-timer generation
+    lepoch: jnp.ndarray  # int32 leadership epoch (heartbeat-timer guard)
+    # network
+    links: enet.LinkState
+    # election-safety ring [H]
+    hist_term: jnp.ndarray  # int32
+    hist_node: jnp.ndarray  # int32
+    hist_valid: jnp.ndarray  # bool
+    hist_pos: jnp.ndarray  # int32
+    # sweep outputs
+    violation: jnp.ndarray  # bool
+    elections: jnp.ndarray  # int32
+    msgs_sent: jnp.ndarray  # int32
+    msgs_delivered: jnp.ndarray  # int32
+
+
+def _pay(*vals, slots: int = PAYLOAD_SLOTS) -> jnp.ndarray:
+    out = jnp.zeros((slots,), jnp.int32)
+    for i, v in enumerate(vals):
+        out = out.at[i].set(jnp.asarray(v, jnp.int32))
+    return out
+
+
+def _broadcast(cfg: RaftConfig, w: RaftState, now, src, mtype, term, rand, enable):
+    """Emit slots 0..N-1: one message per destination node (self slot
+    disabled), each individually link-tested (loss/clog/latency draws)."""
+    n = cfg.num_nodes
+    times = jnp.zeros((n,), jnp.int64)
+    kinds = jnp.full((n,), K_MSG, jnp.int32)
+    pays = jnp.zeros((n, PAYLOAD_SLOTS), jnp.int32)
+    enables = jnp.zeros((n,), bool)
+    for i in range(n):
+        t, deliver = enet.route(w.links, now, src, jnp.int32(i), rand[2 * i], rand[2 * i + 1])
+        on = enable & (i != src) & deliver
+        times = times.at[i].set(t)
+        pays = pays.at[i].set(_pay(i, mtype, src, term))
+        enables = enables.at[i].set(on)
+    sent = jnp.where(enable, jnp.int32(cfg.num_nodes - 1), 0)
+    delivered = jnp.sum(enables, dtype=jnp.int32)
+    return times, kinds, pays, enables, sent, delivered
+
+
+_DISABLED_EXTRA = None  # sentinel: an unused extra slot
+
+
+def _emits(cfg: RaftConfig, bcast, *extras) -> Emits:
+    """Pack N broadcast slots + 2 extra slots (timers/replies) into Emits.
+
+    Each extra is ``(time, kind, pay, enable)`` or None (disabled slot);
+    every handler emits the same fixed shape (N+2 events)."""
+    times, kinds, pays, enables = bcast
+    assert len(extras) == 2
+    for extra in extras:
+        if extra is None:
+            et = jnp.zeros((), jnp.int64)
+            ek = jnp.zeros((), jnp.int32)
+            ep = jnp.zeros((PAYLOAD_SLOTS,), jnp.int32)
+            eo = jnp.zeros((), bool)
+        else:
+            et, ek, ep, eo = extra
+            et = jnp.asarray(et, jnp.int64)
+            ek = jnp.asarray(ek, jnp.int32)
+            eo = jnp.asarray(eo, bool)
+        times = jnp.concatenate([times, et[None]])
+        kinds = jnp.concatenate([kinds, ek[None]])
+        pays = jnp.concatenate([pays, ep[None]])
+        enables = jnp.concatenate([enables, eo[None]])
+    return Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def _no_bcast(cfg: RaftConfig):
+    n = cfg.num_nodes
+    return (
+        jnp.zeros((n,), jnp.int64),
+        jnp.full((n,), K_MSG, jnp.int32),
+        jnp.zeros((n, PAYLOAD_SLOTS), jnp.int32),
+        jnp.zeros((n,), bool),
+    )
+
+
+def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
+    """Online election-safety check: a term may elect at most one leader."""
+    dup = jnp.any(w.hist_valid & (w.hist_term == term) & (w.hist_node != node))
+    slot = w.hist_pos % cfg.history
+    return w._replace(
+        violation=w.violation | (won & dup),
+        hist_term=w.hist_term.at[slot].set(jnp.where(won, term, w.hist_term[slot])),
+        hist_node=w.hist_node.at[slot].set(jnp.where(won, node, w.hist_node[slot])),
+        hist_valid=w.hist_valid.at[slot].set(w.hist_valid[slot] | won),
+        hist_pos=jnp.where(won, w.hist_pos + 1, w.hist_pos),
+        elections=jnp.where(won, w.elections + 1, w.elections),
+    )
+
+
+# -- event handlers (each: (w, now, pay, rand) -> (w, Emits)) ---------------
+
+
+def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    node, gen = pay[0], pay[1]
+    valid = w.alive[node] & (gen == w.tgen[node]) & (w.role[node] != LEADER)
+    # a live leader/candidate signal arrived since this timer was armed?
+    recent = (w.last_hb[node] + cfg.election_lo_ns) > now
+    starting = valid & ~recent
+
+    new_term = w.term[node] + 1
+    self_bit = jnp.left_shift(jnp.uint32(1), node.astype(jnp.uint32))
+    w2 = w._replace(
+        term=w.term.at[node].set(jnp.where(starting, new_term, w.term[node])),
+        role=w.role.at[node].set(jnp.where(starting, CANDIDATE, w.role[node])),
+        voted=w.voted.at[node].set(jnp.where(starting, node, w.voted[node])),
+        votes=w.votes.at[node].set(jnp.where(starting, self_bit, w.votes[node])),
+        last_hb=w.last_hb.at[node].set(jnp.where(starting, now, w.last_hb[node])),
+    )
+    bcast = _broadcast(cfg, w2, now, node, M_REQ_VOTE, new_term, rand, starting)
+    timeout = bounded(rand[2 * cfg.num_nodes], cfg.election_lo_ns, cfg.election_hi_ns)
+    emits = _emits(
+        cfg,
+        bcast[:4],
+        # one live election timer per node, always re-armed while valid
+        (now + timeout, K_ELECTION, _pay(node, w.tgen[node]), valid),
+        _DISABLED_EXTRA,
+    )
+    w2 = w2._replace(
+        msgs_sent=w2.msgs_sent + bcast[4], msgs_delivered=w2.msgs_delivered + bcast[5]
+    )
+    return w2, emits
+
+
+def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    node, epoch = pay[0], pay[1]
+    valid = w.alive[node] & (w.role[node] == LEADER) & (epoch == w.lepoch[node])
+    bcast = _broadcast(cfg, w, now, node, M_APPEND, w.term[node], rand, valid)
+    emits = _emits(
+        cfg,
+        bcast[:4],
+        (now + cfg.heartbeat_ns, K_HEARTBEAT, _pay(node, epoch), valid),
+        _DISABLED_EXTRA,
+    )
+    w2 = w._replace(
+        msgs_sent=w.msgs_sent + bcast[4], msgs_delivered=w.msgs_delivered + bcast[5]
+    )
+    return w2, emits
+
+
+def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    dst, mtype, src, mterm = pay[0], pay[1], pay[2], pay[3]
+    live = w.alive[dst]
+    was_leader = live & (w.role[dst] == LEADER)
+
+    # term catch-up (Raft §5.1): any message with a higher term demotes
+    higher = live & (mterm > w.term[dst])
+    term_d = jnp.where(higher, mterm, w.term[dst])
+    role_d = jnp.where(higher, FOLLOWER, w.role[dst])
+    voted_d = jnp.where(higher, -1, w.voted[dst])
+
+    is_rv = live & (mtype == M_REQ_VOTE)
+    is_vg = live & (mtype == M_VOTE_GRANT)
+    is_ap = live & (mtype == M_APPEND)
+
+    # RequestVote: grant iff same term and not voted for anyone else
+    grant = is_rv & (mterm == term_d) & ((voted_d == -1) | (voted_d == src))
+    voted_d = jnp.where(grant, src, voted_d)
+
+    # VoteGrant: count iff still candidate in that term
+    counted = is_vg & (role_d == CANDIDATE) & (mterm == term_d)
+    src_bit = jnp.left_shift(jnp.uint32(1), src.astype(jnp.uint32))
+    votes_d = jnp.where(counted, w.votes[dst] | src_bit, w.votes[dst])
+    majority = cfg.num_nodes // 2 + 1
+    won = counted & (jax.lax.population_count(votes_d).astype(jnp.int32) >= majority)
+    role_d = jnp.where(won, LEADER, role_d)
+
+    # AppendEntries (heartbeat): same-term leader signal resets the
+    # election timer basis and demotes a same-term candidate
+    heard = is_ap & (mterm == term_d)
+    role_d = jnp.where(heard & (role_d == CANDIDATE), FOLLOWER, role_d)
+    reset_hb = heard | grant | won
+
+    # a leader demoted by a higher term must re-enter the election-timer
+    # chain (its own timer chain ended when it fired during leadership);
+    # bump tgen so any stale timer stays dead, then arm a fresh one below
+    demoted = was_leader & (role_d != LEADER)
+    tgen_d = jnp.where(demoted, w.tgen[dst] + 1, w.tgen[dst])
+
+    w2 = w._replace(
+        term=w.term.at[dst].set(term_d),
+        role=w.role.at[dst].set(role_d),
+        voted=w.voted.at[dst].set(voted_d),
+        votes=w.votes.at[dst].set(votes_d),
+        tgen=w.tgen.at[dst].set(tgen_d),
+        lepoch=w.lepoch.at[dst].set(jnp.where(won, w.lepoch[dst] + 1, w.lepoch[dst])),
+        last_hb=w.last_hb.at[dst].set(jnp.where(reset_hb, now, w.last_hb[dst])),
+    )
+    w2 = _record_election(cfg, w2, term_d, dst, won)
+
+    # on win: broadcast immediate heartbeats + arm the heartbeat timer
+    bcast = _broadcast(cfg, w2, now, dst, M_APPEND, term_d, rand, won)
+    # extra slot: either the heartbeat timer (won) or the vote reply (grant)
+    # — mutually exclusive by message type
+    rt, rdeliver = enet.route(
+        w.links, now, dst, src, rand[2 * cfg.num_nodes], rand[2 * cfg.num_nodes + 1]
+    )
+    extra_time = jnp.where(won, now + cfg.heartbeat_ns, rt)
+    extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
+    extra_pay = jnp.where(
+        won,
+        _pay(dst, w2.lepoch[dst]),
+        _pay(src, M_VOTE_GRANT, dst, mterm),
+    )
+    extra_on = won | (grant & rdeliver)
+    # second extra: the demoted ex-leader's fresh election timer
+    retimeout = bounded(
+        rand[2 * cfg.num_nodes + 2], cfg.election_lo_ns, cfg.election_hi_ns
+    )
+    emits = _emits(
+        cfg,
+        bcast[:4],
+        (extra_time, extra_kind, extra_pay, extra_on),
+        (now + retimeout, K_ELECTION, _pay(dst, tgen_d), demoted),
+    )
+    w2 = w2._replace(
+        msgs_sent=w2.msgs_sent + bcast[4] + jnp.where(grant, 1, 0),
+        msgs_delivered=w2.msgs_delivered
+        + bcast[5]
+        + jnp.where(grant & rdeliver, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_crash(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    node = pay[0]
+    # durable state (term, voted) survives; volatile state resets
+    # (ref kill semantics: task/mod.rs:347-364 — tasks dropped, state wiped)
+    w2 = w._replace(
+        alive=w.alive.at[node].set(False),
+        role=w.role.at[node].set(FOLLOWER),
+        votes=w.votes.at[node].set(jnp.uint32(0)),
+        tgen=w.tgen.at[node].set(w.tgen[node] + 1),
+        lepoch=w.lepoch.at[node].set(w.lepoch[node] + 1),
+    )
+    return w2, _emits(cfg, _no_bcast(cfg), _DISABLED_EXTRA, _DISABLED_EXTRA)
+
+
+def _on_restart(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    node = pay[0]
+    was_dead = ~w.alive[node]
+    w2 = w._replace(
+        alive=w.alive.at[node].set(True),
+        role=w.role.at[node].set(jnp.where(was_dead, FOLLOWER, w.role[node])),
+        last_hb=w.last_hb.at[node].set(jnp.where(was_dead, now, w.last_hb[node])),
+    )
+    timeout = bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns)
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (now + timeout, K_ELECTION, _pay(node, w2.tgen[node]), was_dead),
+        _DISABLED_EXTRA,
+    )
+    return w2, emits
+
+
+def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
+    branches = [
+        partial(_on_election_timer, cfg),
+        partial(_on_heartbeat_timer, cfg),
+        partial(_on_msg, cfg),
+        partial(_on_crash, cfg),
+        partial(_on_restart, cfg),
+    ]
+    return jax.lax.switch(kind, branches, w, now, pay, rand)
+
+
+def _init(cfg: RaftConfig, key):
+    n = cfg.num_nodes
+    ninit = n + 2 * cfg.crashes
+    # init draws live in their own counter namespace, disjoint from the
+    # per-event stream (event counters stay far below 2**31)
+    rand = jax.random.bits(
+        jax.random.fold_in(key, 0x7FFF_FFFF), (ninit + cfg.crashes,), dtype=jnp.uint32
+    )
+    w = RaftState(
+        role=jnp.zeros((n,), jnp.int32),
+        term=jnp.zeros((n,), jnp.int32),
+        voted=jnp.full((n,), -1, jnp.int32),
+        votes=jnp.zeros((n,), jnp.uint32),
+        alive=jnp.ones((n,), bool),
+        last_hb=jnp.zeros((n,), jnp.int64),
+        tgen=jnp.zeros((n,), jnp.int32),
+        lepoch=jnp.zeros((n,), jnp.int32),
+        links=enet.make(n, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns),
+        hist_term=jnp.zeros((cfg.history,), jnp.int32),
+        hist_node=jnp.zeros((cfg.history,), jnp.int32),
+        hist_valid=jnp.zeros((cfg.history,), bool),
+        hist_pos=jnp.zeros((), jnp.int32),
+        violation=jnp.zeros((), bool),
+        elections=jnp.zeros((), jnp.int32),
+        msgs_sent=jnp.zeros((), jnp.int32),
+        msgs_delivered=jnp.zeros((), jnp.int32),
+    )
+    times = jnp.zeros((ninit,), jnp.int64)
+    kinds = jnp.zeros((ninit,), jnp.int32)
+    pays = jnp.zeros((ninit, PAYLOAD_SLOTS), jnp.int32)
+    enables = jnp.ones((ninit,), bool)
+    # one election timer per node
+    for i in range(n):
+        times = times.at[i].set(bounded(rand[i], cfg.election_lo_ns, cfg.election_hi_ns))
+        kinds = kinds.at[i].set(K_ELECTION)
+        pays = pays.at[i].set(_pay(i, 0))
+    # fault plan: crash (node, t) then restart after a random delay
+    for c in range(cfg.crashes):
+        t_crash = bounded(rand[n + 2 * c], 0, cfg.crash_window_ns)
+        delay = bounded(rand[n + 2 * c + 1], cfg.restart_lo_ns, cfg.restart_hi_ns)
+        victim = bounded(rand[ninit + c], 0, n).astype(jnp.int32)
+        times = times.at[n + 2 * c].set(t_crash)
+        kinds = kinds.at[n + 2 * c].set(K_CRASH)
+        pays = pays.at[n + 2 * c].set(_pay(victim))
+        times = times.at[n + 2 * c + 1].set(t_crash + delay)
+        kinds = kinds.at[n + 2 * c + 1].set(K_RESTART)
+        pays = pays.at[n + 2 * c + 1].set(_pay(victim))
+    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def workload(cfg: RaftConfig = RaftConfig()) -> Workload:
+    """Build the engine Workload for a Raft sweep configuration."""
+    return Workload(
+        init=partial(_init, cfg),
+        handle=partial(_handle, cfg),
+        num_rand=2 * cfg.num_nodes + 3,
+        payload_slots=PAYLOAD_SLOTS,
+        max_emits=cfg.num_nodes + 2,
+    )
+
+
+def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
+    """Engine parameters sized for this workload (queue holds worst-case
+    in-flight: N broadcasts from every node + timers + fault plan)."""
+    defaults = dict(
+        queue_capacity=max(64, 4 * cfg.num_nodes * cfg.num_nodes),
+        time_limit_ns=10_000_000_000,
+        max_steps=200_000,
+    )
+    defaults.update(overrides)
+    return EngineConfig(**defaults)
+
+
+def sweep_summary(final) -> dict:
+    """Host-side reduction of a finished sweep's batched EngineState."""
+    w: RaftState = final.wstate
+    import numpy as np
+
+    return {
+        "seeds": int(final.seed.shape[0]),
+        "violations": int(np.sum(np.asarray(w.violation))),
+        "elections_total": int(np.sum(np.asarray(w.elections))),
+        "no_leader_seeds": int(np.sum(np.asarray(w.elections) == 0)),
+        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
+        "events_total": int(np.sum(np.asarray(final.ctr))),
+        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
+        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
+    }

@@ -235,6 +235,18 @@ class Endpoint:
         add_rpc_handler_with_data(self, req_type, handler)
 
 
+async def connect1_ephemeral(dst: "str | Addr") -> Tuple[PipeSender, PipeReceiver]:
+    """Open a reliable connection from an ephemeral port, releasing the
+    port as soon as the connection is established (the pipes don't use the
+    socket table) — the analogue of the reference's RAII Endpoint drop.
+    Shared by the gRPC and etcd clients' call paths."""
+    ep = await Endpoint.bind(("0.0.0.0", 0))
+    try:
+        return await ep.connect1(dst)
+    finally:
+        ep.close()
+
+
 async def lookup_host(addr: "str | Addr") -> List[Addr]:
     """Resolve a host:port through simulated DNS
     (ref ``lookup_host``, net/addr.rs:33-360)."""

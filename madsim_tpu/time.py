@@ -221,6 +221,15 @@ class Sleep(Future):
             self._timer = None
         self._reset()
         self._deadline_ns = deadline.ns
+        if self._wakers:
+            # tasks are already awaiting: re-arm immediately — they won't be
+            # polled again (and so won't re-subscribe) until we fire
+            if self._deadline_ns <= self._time.now_ns:
+                self.set_result(None)
+            else:
+                self._timer = self._time.add_timer_at_ns(
+                    self._deadline_ns, lambda: self.set_result(None)
+                )
 
 
 def sleep(seconds: float) -> Sleep:

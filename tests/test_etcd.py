@@ -1,0 +1,286 @@
+"""etcd sim tests — port of madsim-etcd-client/tests/test.rs (314 lines):
+kv/txn flows, lease TTL expiry on simulated time (a 60 s sleep is instant),
+election campaign/proclaim/observe/resign, request-too-large, timeout
+injection, and dump/load snapshot-restore.
+"""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import etcd
+from madsim_tpu.etcd import (
+    Compare,
+    CompareOp,
+    DeleteOptions,
+    GetOptions,
+    PutOptions,
+    SimServer,
+    Txn,
+    TxnOp,
+)
+from madsim_tpu.grpc import Code, Status
+
+ADDR = "10.0.0.1:2379"
+
+
+def with_cluster(seed, client_fn, timeout_rate=0.0):
+    rt = ms.Runtime(seed=seed)
+
+    async def main():
+        h = ms.current_handle()
+        h.create_node().name("etcd").ip("10.0.0.1").init(
+            lambda: SimServer.builder().timeout_rate(timeout_rate).serve(ADDR)
+        ).build()
+        node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+        return await node.spawn(client_fn())
+
+    return rt.block_on(main())
+
+
+def test_kv_put_get_delete_prefix():
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        kv = client.kv_client()
+        await kv.put("hello", "world", None)
+        resp = await kv.get("hello", None)
+        assert resp.kvs()[0].value_str() == "world"
+        assert resp.count() == 1
+        # versions/revisions advance
+        r1 = (await kv.put("hello", "world2", None)).header().revision()
+        resp = await kv.get("hello", None)
+        assert resp.kvs()[0].mod_revision == r1
+        assert resp.kvs()[0].version == 2
+        # prefix range
+        await kv.put("key/a", "1", None)
+        await kv.put("key/b", "2", None)
+        resp = await kv.get("key/", GetOptions().with_prefix())
+        assert [k.key_str() for k in resp.kvs()] == ["key/a", "key/b"]
+        # delete with prefix
+        dresp = await kv.delete("key/", DeleteOptions().with_prefix())
+        assert dresp.deleted() == 2
+        assert (await kv.get("key/", GetOptions().with_prefix())).count() == 0
+
+    with_cluster(21, run)
+
+
+def test_txn_compare_and_ops():
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        kv = client.kv_client()
+        await kv.put("k", "v1", None)
+        # success branch
+        resp = await kv.txn(
+            Txn()
+            .when([Compare.value("k", CompareOp.EQUAL, "v1")])
+            .and_then([TxnOp.put("k", "v2", None), TxnOp.get("k", None)])
+            .or_else([TxnOp.put("k", "wrong", None)])
+        )
+        assert resp.succeeded()
+        # failure branch + nested txn (recursive — service.rs txn)
+        resp = await kv.txn(
+            Txn()
+            .when([Compare.value("k", CompareOp.EQUAL, "v1")])
+            .and_then([TxnOp.put("k", "nope", None)])
+            .or_else([TxnOp.txn(Txn().and_then([TxnOp.put("k", "v3", None)]))])
+        )
+        assert not resp.succeeded()
+        assert (await kv.get("k", None)).kvs()[0].value_str() == "v3"
+
+    with_cluster(22, run)
+
+
+def test_lease_expiry_on_sim_time():
+    """Lease TTL runs on virtual seconds — sleeping 61 s is instant in
+    wall time (ref tests/test.rs:96-120)."""
+
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        lease = client.lease_client()
+        kv = client.kv_client()
+        granted = await lease.grant(60)
+        lid = granted.id()
+        await kv.put("leased", "v", PutOptions().with_lease(lid))
+        assert (await kv.get("leased", None)).count() == 1
+        # keep alive halfway: lease survives past the original deadline
+        await ms.sleep(30)
+        await lease.keep_alive(lid)
+        await ms.sleep(40)
+        assert (await kv.get("leased", None)).count() == 1
+        ttl = await lease.time_to_live(lid)
+        assert ttl.granted_ttl() == 60
+        # stop keeping alive: expiry deletes the attached key
+        await ms.sleep(61)
+        assert (await kv.get("leased", None)).count() == 0
+        with pytest.raises(Status) as e:
+            await lease.time_to_live(lid)
+        assert e.value.code == Code.NOT_FOUND
+
+    with_cluster(23, run)
+
+
+def test_lease_revoke_deletes_keys():
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        lease, kv = client.lease_client(), client.kv_client()
+        lid = (await lease.grant(600)).id()
+        await kv.put("a", "1", PutOptions().with_lease(lid))
+        await kv.put("b", "2", PutOptions().with_lease(lid))
+        assert (await lease.leases()) == [lid]
+        await lease.revoke(lid)
+        assert (await kv.get("a", None)).count() == 0
+        assert (await kv.get("b", None)).count() == 0
+
+    with_cluster(24, run)
+
+
+def test_election_campaign_observe_resign():
+    """Two campaigners: first wins immediately; on resign the second
+    takes over (ref tests/test.rs election flow)."""
+
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        lease = client.lease_client()
+        el = client.election_client()
+        l1 = (await lease.grant(600)).id()
+        l2 = (await lease.grant(600)).id()
+
+        c1 = await el.campaign("mayor", "alice", l1)
+        assert (await el.leader("mayor")).kv().value_str() == "alice"
+
+        # second campaigner blocks; run it as a task
+        async def second():
+            c2 = await el.campaign("mayor", "bob", l2)
+            return c2
+
+        t2 = ms.spawn(second())
+        await ms.sleep(1)
+        assert not t2.done()
+        # proclaim updates the leader value
+        await el.proclaim("alice-2", c1.leader())
+        assert (await el.leader("mayor")).kv().value_str() == "alice-2"
+        # observe sees changes
+        obs = await el.observe("mayor")
+        first = await obs.next()
+        assert first.value.decode() in ("alice-2", "bob")
+        # resign → bob elected
+        await el.resign(c1.leader())
+        c2 = await t2
+        assert c2.leader().key().startswith(b"mayor/")
+        assert (await el.leader("mayor")).kv().value_str() == "bob"
+        obs.cancel()
+
+    with_cluster(25, run)
+
+
+def test_request_too_large():
+    """1.5 MiB request cap (service.rs:36; ref tests/test.rs:9-40)."""
+
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        kv = client.kv_client()
+        with pytest.raises(Status) as e:
+            await kv.put("big", b"x" * (2 * 1024 * 1024), None)
+        assert e.value.code == Code.INVALID_ARGUMENT
+        assert "too large" in e.value.message
+
+    with_cluster(26, run)
+
+
+def test_timeout_rate_injection():
+    """timeout_rate=1.0: every request hangs 5-15 virtual seconds then
+    fails Unavailable (server.rs:20-25, service.rs:165-176)."""
+
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        t0 = ms.time.elapsed()
+        with pytest.raises(Status) as e:
+            await client.kv_client().put("k", "v", None)
+        assert e.value.code == Code.UNAVAILABLE
+        assert 5.0 <= ms.time.elapsed() - t0 <= 16.0
+
+    with_cluster(27, run, timeout_rate=1.0)
+
+
+def test_dump_load_snapshot_restore():
+    """State dump/load round-trip (service.rs:160-163, sim.rs:70-77)."""
+    rt = ms.Runtime(seed=28)
+
+    async def main():
+        h = ms.current_handle()
+        h.create_node().name("etcd1").ip("10.0.0.1").init(
+            lambda: SimServer.builder().serve(ADDR)
+        ).build()
+        node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            client = await etcd.Client.connect([ADDR])
+            kv = client.kv_client()
+            lid = (await client.lease_client().grant(300)).id()
+            await kv.put("persist", "me", PutOptions().with_lease(lid))
+            await kv.put("also", "this", None)
+            dump = await client.dump()
+            # a fresh server restored from the dump serves the same state
+            h2 = ms.current_handle()
+            h2.create_node().name("etcd2").ip("10.0.0.3").init(
+                lambda: SimServer.builder().load(dump).serve("10.0.0.3:2379")
+            ).build()
+            await ms.sleep(0.1)
+            c2 = await etcd.Client.connect(["10.0.0.3:2379"])
+            resp = await c2.kv_client().get("persist", None)
+            assert resp.kvs()[0].value_str() == "me"
+            assert resp.kvs()[0].lease == lid
+            assert (await c2.kv_client().get("also", None)).count() == 1
+
+        await node.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_watch_prefix_stream():
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        stream = await client.watch_client().watch("w/", prefix=True)
+        kv = client.kv_client()
+
+        async def writer():
+            await kv.put("w/1", "a", None)
+            await kv.put("other", "x", None)
+            await kv.put("w/2", "b", None)
+            await kv.delete("w/1", None)
+
+        ms.spawn(writer())
+        e1 = await stream.next()
+        assert e1.type == etcd.EventType.PUT and e1.kv.key == b"w/1"
+        e2 = await stream.next()
+        assert e2.kv.key == b"w/2"
+        e3 = await stream.next()
+        assert e3.type == etcd.EventType.DELETE and e3.kv.key == b"w/1"
+        stream.cancel()
+
+    with_cluster(29, run)
+
+
+def test_etcd_determinism():
+    def workload():
+        async def main():
+            h = ms.current_handle()
+            h.create_node().name("etcd").ip("10.0.0.1").init(
+                lambda: SimServer.builder().serve(ADDR)
+            ).build()
+            node = h.create_node().name("client").ip("10.0.0.2").build()
+            await ms.sleep(0.1)
+
+            async def run():
+                client = await etcd.Client.connect([ADDR])
+                for i in range(5):
+                    await client.kv_client().put(f"k{i}", f"v{i}", None)
+                assert (await client.kv_client().get(
+                    "k", GetOptions().with_prefix())).count() == 5
+
+            await node.spawn(run())
+
+        return main()
+
+    ms.Runtime.check_determinism(31, workload)

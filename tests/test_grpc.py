@@ -1,0 +1,304 @@
+"""gRPC shim tests — port of the reference's flagship integration suite
+(tonic-example/tests/test.rs, 408 lines): multi-node cluster with all four
+streaming modes, client crash/restart loops, server crash mid-stream,
+unimplemented fallback, interceptors, request timeout; plus balance_list.
+"""
+
+import sys
+
+import pytest
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/examples")
+
+import madsim_tpu as ms
+from madsim_tpu import grpc
+from greeter import Greeter, HelloReply, HelloRequest, serve
+
+SERVER = "10.0.0.1"
+ADDR = f"{SERVER}:50051"
+
+
+def cluster(h, n_clients=1):
+    """1 server + n client nodes with distinct IPs (ref test.rs:22-40)."""
+    server = h.create_node().name("server").ip(SERVER).init(lambda: serve(ADDR)).build()
+    clients = [
+        h.create_node().name(f"client-{i}").ip(f"10.0.0.{i + 2}").build()
+        for i in range(n_clients)
+    ]
+    return server, clients
+
+
+async def connect():
+    channel = await grpc.Endpoint.from_static(f"http://{ADDR}").connect()
+    return grpc.ServiceClient(Greeter, channel)
+
+
+def test_all_streaming_modes():
+    rt = ms.Runtime(seed=10)
+
+    async def main():
+        h = ms.current_handle()
+        _server, (client,) = cluster(h)
+        await ms.sleep(0.1)
+
+        async def run():
+            c = await connect()
+            # unary (test.rs:44-56)
+            r = await c.say_hello(HelloRequest(name="world"))
+            assert r.into_inner().message == "Hello world!"
+            # unary error path
+            with pytest.raises(grpc.Status) as e:
+                await c.say_hello(HelloRequest(name="error"))
+            assert e.value.code == grpc.Code.INVALID_ARGUMENT
+            # server streaming (test.rs:58-76)
+            stream = await c.lots_of_replies(HelloRequest(name="s"))
+            msgs = [m.message async for m in stream]
+            assert msgs == ["0: Hello s!", "1: Hello s!", "2: Hello s!"]
+            # client streaming (test.rs:78-94)
+            r = await c.lots_of_greetings(
+                [HelloRequest(name="a"), HelloRequest(name="b")]
+            )
+            assert r.into_inner().message == "Hello a, b!"
+            # bidi streaming (test.rs:96-119)
+            stream = await c.bidi_hello([HelloRequest(name=x) for x in "xy"])
+            msgs = [m.message async for m in stream]
+            assert msgs == ["Hello x!", "Hello y!"]
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_client_crash_loop():
+    """Kill/restart a calling client 10 times; the server must keep
+    serving (ref test.rs:155-202)."""
+    rt = ms.Runtime(seed=11)
+
+    async def main():
+        h = ms.current_handle()
+        server, _ = cluster(h, n_clients=0)
+
+        def client_init():
+            async def run():
+                c = await connect()
+                while True:
+                    await c.say_hello(HelloRequest(name="w"))
+                    await ms.sleep(0.05)
+
+            return run()
+
+        node = (
+            h.create_node().name("crashy").ip("10.0.0.9").init(client_init).build()
+        )
+        await ms.sleep(0.2)
+        for _ in range(10):
+            await ms.sleep(ms.rand.uniform(0.05, 0.3))
+            h.kill(node)
+            await ms.sleep(ms.rand.uniform(0.01, 0.1))
+            h.restart(node)
+        # server still healthy:
+        probe = h.create_node().name("probe").ip("10.0.0.8").build()
+
+        async def check():
+            c = await connect()
+            r = await c.say_hello(HelloRequest(name="alive"))
+            assert r.into_inner().message == "Hello alive!"
+
+        await probe.spawn(check())
+
+    rt.block_on(main())
+
+
+def test_server_crash_mid_stream():
+    """Kill the server mid-stream: in-flight stream errors Unavailable;
+    after restart calls succeed (ref test.rs:234-278)."""
+    rt = ms.Runtime(seed=12)
+
+    async def main():
+        h = ms.current_handle()
+        server, (client,) = cluster(h)
+        await ms.sleep(0.1)
+
+        async def run():
+            c = await connect()
+            stream = await c.lots_of_replies(HelloRequest(name="s"))
+            first = await stream.message()
+            assert first.message == "0: Hello s!"
+            h.kill(server)
+            with pytest.raises(grpc.Status) as e:
+                while await stream.message() is not None:
+                    pass
+            assert e.value.code == grpc.Code.UNAVAILABLE
+            # new call also fails while down
+            with pytest.raises((grpc.Status, OSError)):
+                await c.say_hello(HelloRequest(name="down"))
+            h.restart(server)
+            await ms.sleep(0.2)
+            r = await c.say_hello(HelloRequest(name="back"))
+            assert r.into_inner().message == "Hello back!"
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_unimplemented_service():
+    """Unknown service/method → UNIMPLEMENTED (ref test.rs:281-318)."""
+    rt = ms.Runtime(seed=13)
+
+    @grpc.service("other.Unknown")
+    class Unknown:
+        @grpc.unary
+        async def nope(self, request):
+            return None
+
+    async def main():
+        h = ms.current_handle()
+        _server, (client,) = cluster(h)
+        await ms.sleep(0.1)
+
+        async def run():
+            channel = await grpc.Endpoint.from_static(f"http://{ADDR}").connect()
+            c = grpc.ServiceClient(Unknown, channel)
+            with pytest.raises(grpc.Status) as e:
+                await c.nope(HelloRequest(name="x"))
+            assert e.value.code == grpc.Code.UNIMPLEMENTED
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_interceptor():
+    """Client interceptor can mutate metadata and reject requests
+    (ref test.rs:321-360; sim.rs:94-101)."""
+    rt = ms.Runtime(seed=14)
+
+    @grpc.service("helloworld.Echo")
+    class Echo:
+        @grpc.unary
+        async def echo_meta(self, request: grpc.Request):
+            return HelloReply(message=request.metadata.get("x-token", ""))
+
+    async def main():
+        h = ms.current_handle()
+        h.create_node().name("server").ip(SERVER).init(
+            lambda: grpc.Server.builder().add_service(Echo()).serve(ADDR)
+        ).build()
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            channel = await grpc.Endpoint.from_static(f"http://{ADDR}").connect()
+
+            def add_token(req: grpc.Request) -> grpc.Request:
+                req.metadata["x-token"] = "secret"
+                return req
+
+            c = grpc.ServiceClient.with_interceptor(Echo, channel, add_token)
+            r = await c.echo_meta(HelloRequest(name="x"))
+            assert r.into_inner().message == "secret"
+
+            def reject(req: grpc.Request) -> grpc.Request:
+                raise grpc.Status.permission_denied("no token")
+
+            c2 = grpc.ServiceClient.with_interceptor(Echo, channel, reject)
+            with pytest.raises(grpc.Status) as e:
+                await c2.echo_meta(HelloRequest(name="x"))
+            assert e.value.code == grpc.Code.PERMISSION_DENIED
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_request_timeout():
+    """grpc-timeout: a slow handler trips the client deadline with
+    CANCELLED "Timeout expired" (ref test.rs:363-408)."""
+    rt = ms.Runtime(seed=15)
+
+    async def main():
+        h = ms.current_handle()
+        _server, (client,) = cluster(h)
+        await ms.sleep(0.1)
+
+        async def run():
+            c = await connect()
+            req = grpc.Request(HelloRequest(name="slow", delay_s=10.0), timeout=1.0)
+            with pytest.raises(grpc.Status) as e:
+                await c.say_hello(req)
+            assert e.value.code == grpc.Code.CANCELLED
+            assert "Timeout expired" in e.value.message
+            # channel-level default timeout (Endpoint::timeout)
+            channel = (
+                await grpc.Endpoint.from_static(f"http://{ADDR}").timeout(0.5).connect()
+            )
+            c2 = grpc.ServiceClient(Greeter, channel)
+            with pytest.raises(grpc.Status):
+                await c2.say_hello(HelloRequest(name="slow", delay_s=10.0))
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_balance_list_round_robin_random():
+    """balance_list spreads calls over endpoints at random
+    (ref transport/channel.rs:294-307)."""
+    rt = ms.Runtime(seed=16)
+
+    @grpc.service("helloworld.WhoAmI")
+    class WhoAmI:
+        def __init__(self, tag: str = "?"):
+            self.tag = tag
+
+        @grpc.unary
+        async def who(self, request):
+            return HelloReply(message=self.tag)
+
+    async def main():
+        h = ms.current_handle()
+        for i, ip in enumerate(["10.0.1.1", "10.0.1.2", "10.0.1.3"]):
+            h.create_node().name(f"s{i}").ip(ip).init(
+                lambda i=i, ip=ip: grpc.Server.builder()
+                .add_service(WhoAmI(tag=f"s{i}"))
+                .serve(f"{ip}:50051")
+            ).build()
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            channel = grpc.Channel.balance_list(
+                [grpc.Endpoint.from_static(f"http://10.0.1.{j}:50051") for j in (1, 2, 3)]
+            )
+            c = grpc.ServiceClient(WhoAmI, channel)
+            seen = set()
+            for _ in range(30):
+                r = await c.who(HelloRequest(name="x"))
+                seen.add(r.into_inner().message)
+            assert seen == {"s0", "s1", "s2"}
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_determinism_of_grpc_workload():
+    """Same seed ⇒ identical RNG log for a gRPC-heavy workload."""
+
+    def workload():
+        async def main():
+            h = ms.current_handle()
+            _server, (client,) = cluster(h)
+            await ms.sleep(0.1)
+
+            async def run():
+                c = await connect()
+                for _ in range(5):
+                    await c.say_hello(HelloRequest(name="d"))
+
+            await client.spawn(run())
+
+        return main()
+
+    ms.Runtime.check_determinism(77, workload)

@@ -1,0 +1,67 @@
+"""Scale-out tier: sharded sweep == unsharded sweep, cross-backend parity.
+
+These are the multi-chip guarantees of SURVEY.md §7 stage 7: sharding the
+seed batch over a mesh must not change any seed's execution (pure DP), and
+the integer-only engine must produce bit-identical results on every
+backend (the CPU-replay contract).
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.rng import prob_to_q32
+from madsim_tpu.models import raft
+from madsim_tpu import parallel
+
+CFG = raft.RaftConfig(num_nodes=3, crashes=1, loss_q32=prob_to_q32(0.01))
+ECFG = raft.engine_config(CFG, queue_capacity=32, time_limit_ns=1_000_000_000, max_steps=8_000)
+
+
+def _cpu_devices(n):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices (XLA_FLAGS force_host_platform_device_count)")
+    return devs[:n]
+
+
+def test_sharded_sweep_matches_unsharded():
+    wl = raft.workload(CFG)
+    seeds = jnp.arange(16, dtype=jnp.int64)
+    mesh = parallel.seed_mesh(_cpu_devices(8))
+    sharded = parallel.run_sweep_sharded(wl, ECFG, seeds, mesh)
+
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        plain = ecore.run_sweep(wl, ECFG, seeds)
+
+    for path, a in zip(jax.tree.leaves(sharded), jax.tree.leaves(plain)):
+        if jnp.issubdtype(path.dtype, jnp.integer) or path.dtype == bool:
+            assert jnp.array_equal(jax.device_get(path), jax.device_get(a))
+
+
+def test_cross_backend_bit_exact():
+    """CPU vs session-default backend (TPU when tunneled): identical."""
+    wl = raft.workload(CFG)
+    seeds = jnp.arange(8, dtype=jnp.int64)
+    default = ecore.run_sweep(wl, ECFG, seeds)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        on_cpu = ecore.run_sweep(wl, ECFG, seeds)
+    assert jnp.array_equal(jax.device_get(default.ctr), jax.device_get(on_cpu.ctr))
+    assert jnp.array_equal(jax.device_get(default.now_ns), jax.device_get(on_cpu.now_ns))
+    assert jnp.array_equal(
+        jax.device_get(default.wstate.elections), jax.device_get(on_cpu.wstate.elections)
+    )
+    assert jnp.array_equal(
+        jax.device_get(default.wstate.msgs_delivered),
+        jax.device_get(on_cpu.wstate.msgs_delivered),
+    )
+
+
+def test_mesh_size_must_divide_batch():
+    wl = raft.workload(CFG)
+    mesh = parallel.seed_mesh(_cpu_devices(8))
+    with pytest.raises(Exception):
+        parallel.run_sweep_sharded(wl, ECFG, jnp.arange(12, dtype=jnp.int64), mesh)
