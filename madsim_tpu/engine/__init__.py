@@ -30,6 +30,10 @@ hot comparisons are cheap relative to event dispatch.
 import jax
 
 jax.config.update("jax_enable_x64", True)
+# the engine's draw stream (and the native C++ replay of it,
+# madsim_tpu/native) is defined by the partitionable threefry counter
+# scheme — pin it against future default changes
+jax.config.update("jax_threefry_partitionable", True)
 
 from .core import (  # noqa: E402
     EngineConfig,

@@ -1,0 +1,72 @@
+"""Sweep checkpoint/resume: the engine state is arrays, so snapshots are
+free.
+
+The reference has no core snapshotting — only the etcd sim's dump/load
+(SURVEY.md §5 "checkpoint/resume"). The SoA engine generalizes the
+pattern: a whole in-flight seed batch (clocks, queues, RNG counters,
+workload actor state) round-trips through one ``.npz`` file, and
+``resume_sweep`` continues stepping it — enabling long sweeps to survive
+preemption and failed seeds to be re-examined from mid-run state.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .core import EngineConfig, EngineState, Workload, step_batch
+
+_FORMAT_VERSION = 1
+
+
+def save_sweep(state: EngineState, path: str) -> None:
+    """Serialize a batched EngineState to ``path`` (.npz)."""
+    leaves, treedef = jax.tree.flatten(state)
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            # typed PRNG keys serialize as their raw uint32 words
+            arrays[f"leaf_{i}__key"] = np.asarray(jax.random.key_data(leaf))
+        else:
+            arrays[f"leaf_{i}"] = np.asarray(leaf)
+    np.savez_compressed(path, __version__=_FORMAT_VERSION, **arrays)
+
+
+def load_sweep(path: str, like: EngineState) -> EngineState:
+    """Restore a checkpoint; ``like`` supplies the pytree structure (build
+    it with ``init_sweep`` on any seed vector of the same shape/config)."""
+    data = np.load(path)
+    assert int(data["__version__"]) == _FORMAT_VERSION
+    leaves, treedef = jax.tree.flatten(like)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if f"leaf_{i}__key" in data:
+            out.append(jax.random.wrap_key_data(jnp.asarray(data[f"leaf_{i}__key"])))
+        else:
+            out.append(jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype))
+    return jax.tree.unflatten(treedef, out)
+
+
+def resume_sweep(
+    workload: Workload, cfg: EngineConfig, state: EngineState
+) -> EngineState:
+    """Continue a (possibly restored) sweep until every seed finishes."""
+    from functools import partial
+
+    @partial(jax.jit, static_argnums=(0, 1))
+    def run(workload: Workload, cfg: EngineConfig, state: EngineState):
+        def cond(carry: Any):
+            s, iters = carry
+            return jnp.any(~s.done) & (iters < cfg.max_steps)
+
+        def body(carry: Any):
+            s, iters = carry
+            return step_batch(workload, cfg, s), iters + 1
+
+        s, _ = jax.lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
+        return s
+
+    return run(workload, cfg, state)

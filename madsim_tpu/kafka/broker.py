@@ -1,0 +1,174 @@
+"""The broker state machine (madsim-rdkafka/src/sim/broker.rs).
+
+Pure deterministic state: topics → partitions → append-only message logs
+with log-end-offset/low-watermark bookkeeping, round-robin partition
+assignment for keyless produce (broker.rs:80-101), offset-for-timestamp
+lookup, and fetch honoring ``fetch_max_bytes`` / ``max_partition_fetch_
+bytes`` (broker.rs:104-146).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+
+class KafkaBrokerError(Exception):
+    """Broker-side error (serialized back to clients as KafkaError)."""
+
+
+@dataclass
+class OwnedMessage:
+    """rdkafka ``OwnedMessage``."""
+
+    topic: str
+    partition: int
+    offset: int
+    timestamp_ms: int
+    key: Optional[bytes]
+    payload: Optional[bytes]
+
+    def size(self) -> int:
+        return len(self.key or b"") + len(self.payload or b"")
+
+
+@dataclass
+class Watermarks:
+    low: int
+    high: int
+
+
+@dataclass
+class Partition:
+    log: List[OwnedMessage] = field(default_factory=list)
+    base_offset: int = 0  # low watermark (nothing is ever compacted here)
+
+    @property
+    def log_end_offset(self) -> int:
+        return self.base_offset + len(self.log)
+
+
+@dataclass
+class Topic:
+    name: str
+    partitions: List[Partition]
+    next_rr: int = 0  # round-robin cursor for keyless produce
+
+
+class Broker:
+    """The single global broker (one mutex-guarded instance in the
+    reference, sim_broker.rs:14-21)."""
+
+    def __init__(self) -> None:
+        self.topics: Dict[str, Topic] = {}
+
+    # -- admin -------------------------------------------------------------
+
+    def create_topic(self, name: str, num_partitions: int) -> None:
+        if name in self.topics:
+            raise KafkaBrokerError(f"topic already exists: {name!r}")
+        if num_partitions <= 0:
+            raise KafkaBrokerError("num_partitions must be positive")
+        self.topics[name] = Topic(name, [Partition() for _ in range(num_partitions)])
+
+    def delete_topic(self, name: str) -> None:
+        if name not in self.topics:
+            raise KafkaBrokerError(f"unknown topic: {name!r}")
+        del self.topics[name]
+
+    def _topic(self, name: str) -> Topic:
+        t = self.topics.get(name)
+        if t is None:
+            raise KafkaBrokerError(f"unknown topic: {name!r}")
+        return t
+
+    def _partition(self, topic: str, partition: int) -> Partition:
+        t = self._topic(topic)
+        if not 0 <= partition < len(t.partitions):
+            raise KafkaBrokerError(f"unknown partition: {topic}[{partition}]")
+        return t.partitions[partition]
+
+    # -- produce (broker.rs:80-101) ----------------------------------------
+
+    def produce(
+        self,
+        topic: str,
+        partition: Optional[int],
+        key: Optional[bytes],
+        payload: Optional[bytes],
+        timestamp_ms: int,
+    ) -> Tuple[int, int]:
+        """Append one message; keyless/partitionless records go round-robin.
+        Returns (partition, offset)."""
+        t = self._topic(topic)
+        if partition is None:
+            if key is not None:
+                # stable key hash (rdkafka uses crc32 of the key)
+                import zlib
+
+                partition = zlib.crc32(key) % len(t.partitions)
+            else:
+                partition = t.next_rr % len(t.partitions)
+                t.next_rr += 1
+        p = self._partition(topic, partition)
+        msg = OwnedMessage(
+            topic=topic,
+            partition=partition,
+            offset=p.log_end_offset,
+            timestamp_ms=timestamp_ms,
+            key=key,
+            payload=payload,
+        )
+        p.log.append(msg)
+        return partition, msg.offset
+
+    # -- fetch (broker.rs:104-146) -----------------------------------------
+
+    def fetch(
+        self,
+        topic: str,
+        partition: int,
+        offset: int,
+        fetch_max_bytes: int,
+        max_partition_fetch_bytes: int,
+    ) -> List[OwnedMessage]:
+        p = self._partition(topic, partition)
+        start = max(offset, p.base_offset) - p.base_offset
+        out: List[OwnedMessage] = []
+        budget = min(fetch_max_bytes, max_partition_fetch_bytes)
+        for msg in p.log[start:]:
+            if out and msg.size() > budget:
+                break
+            out.append(msg)
+            budget -= msg.size()
+            if budget <= 0:
+                break
+        return out
+
+    # -- lookups -----------------------------------------------------------
+
+    def watermarks(self, topic: str, partition: int) -> Watermarks:
+        p = self._partition(topic, partition)
+        return Watermarks(low=p.base_offset, high=p.log_end_offset)
+
+    def offsets_for_times(
+        self, queries: List[Tuple[str, int, int]]
+    ) -> List[Tuple[str, int, Optional[int]]]:
+        """For each (topic, partition, ts): the first offset with
+        timestamp >= ts, or None past the end (broker.rs offset lookup)."""
+        out = []
+        for topic, partition, ts in queries:
+            p = self._partition(topic, partition)
+            found: Optional[int] = None
+            for msg in p.log:
+                if msg.timestamp_ms >= ts:
+                    found = msg.offset
+                    break
+            out.append((topic, partition, found))
+        return out
+
+    def metadata(self, topic: Optional[str] = None) -> Dict[str, int]:
+        """topic → partition count (FetchMetadata)."""
+        if topic is not None:
+            return {topic: len(self._topic(topic).partitions)}
+        return {name: len(t.partitions) for name, t in sorted(self.topics.items())}

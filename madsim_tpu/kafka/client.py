@@ -1,0 +1,330 @@
+"""Kafka clients (madsim-rdkafka/src/sim/{producer,consumer,admin}.rs).
+
+API mirrors rust-rdkafka's shape: a string-map ``ClientConfig``
+(consumer.rs:70-103), ``BaseProducer`` buffering until ``flush``,
+``FutureProducer`` with ``linger.ms`` batching delay, ``BaseConsumer`` with
+assign/seek/poll fetch loops honoring the fetch byte budgets, a
+``StreamConsumer`` that awaits messages, and an ``AdminClient``.
+Offset commits are not modeled (the reference sim doesn't model consumer
+groups either — assignment is manual).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional, Tuple, Type, TypeVar
+
+from .. import time as mstime
+from ..net.endpoint import connect1_ephemeral
+from .broker import OwnedMessage, Watermarks
+
+T = TypeVar("T")
+
+
+class KafkaError(Exception):
+    pass
+
+
+class ClientConfig:
+    """String-map config (rdkafka ``ClientConfig``)."""
+
+    def __init__(self) -> None:
+        self._map: Dict[str, str] = {}
+
+    def set(self, key: str, value: "str | int | float") -> "ClientConfig":
+        self._map[key] = str(value)
+        return self
+
+    def get(self, key: str, default: Optional[str] = None) -> Optional[str]:
+        return self._map.get(key, default)
+
+    def get_int(self, key: str, default: int) -> int:
+        v = self._map.get(key)
+        return int(v) if v is not None else default
+
+    def get_float(self, key: str, default: float) -> float:
+        v = self._map.get(key)
+        return float(v) if v is not None else default
+
+    async def create(self, cls: Type[T]) -> T:
+        """rdkafka ``config.create::<T>()``."""
+        return cls(self)  # type: ignore[call-arg]
+
+
+class _BrokerConn:
+    """One request/response exchange per operation (sim_broker protocol)."""
+
+    def __init__(self, config: ClientConfig):
+        servers = config.get("bootstrap.servers")
+        if not servers:
+            raise KafkaError("bootstrap.servers is required")
+        self._addr = servers.split(",")[0]
+
+    async def call(self, req: tuple) -> Any:
+        try:
+            tx, rx = await connect1_ephemeral(self._addr)
+            await tx.send(req)
+            tx.close()
+            rsp = await rx.recv()
+        except (ConnectionError, OSError) as e:
+            raise KafkaError(f"broker transport error: {e}") from None
+        if rsp is None:
+            raise KafkaError("broker connection closed")
+        kind, payload = rsp
+        if kind == "err":
+            raise KafkaError(payload)
+        return payload
+
+
+# -- records ----------------------------------------------------------------
+
+
+@dataclass
+class BaseRecord:
+    topic: str
+    partition: Optional[int] = None
+    key: Optional[bytes] = None
+    payload: Optional[bytes] = None
+
+    @staticmethod
+    def to(topic: str) -> "BaseRecord":
+        return BaseRecord(topic)
+
+    def with_partition(self, p: int) -> "BaseRecord":
+        self.partition = p
+        return self
+
+    def with_key(self, key: "bytes | str") -> "BaseRecord":
+        self.key = key.encode() if isinstance(key, str) else key
+        return self
+
+    def with_payload(self, payload: "bytes | str") -> "BaseRecord":
+        self.payload = payload.encode() if isinstance(payload, str) else payload
+        return self
+
+
+FutureRecord = BaseRecord  # same shape; only the send path differs
+
+
+# -- producers (sim/producer.rs) --------------------------------------------
+
+
+class BaseProducer:
+    """Buffers records locally until ``flush`` (sim producer semantics)."""
+
+    def __init__(self, config: ClientConfig):
+        self._conn = _BrokerConn(config)
+        self._buffer: List[BaseRecord] = []
+
+    def send(self, record: BaseRecord) -> None:
+        self._buffer.append(record)
+
+    def poll(self, _timeout_s: float = 0.0) -> None:
+        """librdkafka poll pump — a no-op here (no delivery callbacks)."""
+
+    async def flush(self, _timeout_s: float = 30.0) -> None:
+        buffered, self._buffer = self._buffer, []
+        for rec in buffered:
+            await self._conn.call(
+                ("produce", rec.topic, rec.partition, rec.key, rec.payload)
+            )
+
+    def in_flight_count(self) -> int:
+        return len(self._buffer)
+
+
+class FutureProducer:
+    """Per-record async send returning (partition, offset); honors a
+    ``linger.ms`` batching delay on virtual time."""
+
+    def __init__(self, config: ClientConfig):
+        self._conn = _BrokerConn(config)
+        self._linger_s = config.get_float("linger.ms", 0.0) / 1000.0
+
+    async def send(
+        self, record: BaseRecord, _queue_timeout_s: float = 0.0
+    ) -> Tuple[int, int]:
+        if self._linger_s > 0:
+            await mstime.sleep(self._linger_s)
+        return tuple(
+            await self._conn.call(
+                ("produce", record.topic, record.partition, record.key, record.payload)
+            )
+        )
+
+
+# -- consumers (sim/consumer.rs) --------------------------------------------
+
+
+@dataclass
+class _Assignment:
+    topic: str
+    partition: int
+    position: int  # next offset to fetch
+
+
+class TopicPartitionList:
+    def __init__(self) -> None:
+        self.elements: List[Tuple[str, int, Optional[int]]] = []
+
+    def add_partition(self, topic: str, partition: int) -> "TopicPartitionList":
+        self.elements.append((topic, partition, None))
+        return self
+
+    def add_partition_offset(
+        self, topic: str, partition: int, offset: int
+    ) -> "TopicPartitionList":
+        self.elements.append((topic, partition, offset))
+        return self
+
+
+class BaseConsumer:
+    """assign/seek/poll fetch loop (sim consumer; fetch byte budgets from
+    config: fetch.max.bytes / max.partition.fetch.bytes)."""
+
+    POLL_TICK_S = 0.01
+
+    def __init__(self, config: ClientConfig):
+        self._conn = _BrokerConn(config)
+        self._fetch_max = config.get_int("fetch.max.bytes", 52_428_800)
+        self._partition_max = config.get_int("max.partition.fetch.bytes", 1_048_576)
+        self._assignments: List[_Assignment] = []
+        self._buffer: List[OwnedMessage] = []
+        self._rr = 0
+
+    async def subscribe(self, topics: List[str]) -> None:
+        """Assign every partition of the topics from the beginning (no
+        consumer groups in the sim — subscription = full assignment).
+        Replaces any previous subscription, like rdkafka's subscribe."""
+        self._assignments.clear()
+        self._buffer.clear()
+        for topic in topics:
+            meta = await self._conn.call(("metadata", topic))
+            for p in range(meta[topic]):
+                await self._assign_one(topic, p, None)
+
+    async def assign(self, tpl: TopicPartitionList) -> None:
+        self._assignments.clear()
+        self._buffer.clear()
+        for topic, partition, offset in tpl.elements:
+            await self._assign_one(topic, partition, offset)
+
+    async def _assign_one(self, topic: str, partition: int, offset: Optional[int]) -> None:
+        if offset is None:
+            wm: Watermarks = await self._conn.call(("watermarks", topic, partition))
+            offset = wm.low
+        self._assignments.append(_Assignment(topic, partition, offset))
+
+    def seek(self, topic: str, partition: int, offset: int) -> None:
+        for a in self._assignments:
+            if a.topic == topic and a.partition == partition:
+                a.position = offset
+                self._buffer = [
+                    m for m in self._buffer
+                    if not (m.topic == topic and m.partition == partition)
+                ]
+                return
+        raise KafkaError(f"not assigned: {topic}[{partition}]")
+
+    async def _fetch_round(self) -> None:
+        if not self._assignments:
+            return
+        n = len(self._assignments)
+        for i in range(n):
+            a = self._assignments[(self._rr + i) % n]
+            msgs: List[OwnedMessage] = await self._conn.call(
+                ("fetch", a.topic, a.partition, a.position,
+                 self._fetch_max, self._partition_max)
+            )
+            if msgs:
+                a.position = msgs[-1].offset + 1
+                self._buffer.extend(msgs)
+                self._rr = (self._rr + i + 1) % n
+                return
+        self._rr = (self._rr + 1) % n
+
+    async def poll(self, timeout_s: float = 1.0) -> Optional[OwnedMessage]:
+        deadline = mstime.now_instant() + timeout_s
+        while True:
+            if self._buffer:
+                return self._buffer.pop(0)
+            await self._fetch_round()
+            if self._buffer:
+                return self._buffer.pop(0)
+            if mstime.now_instant() >= deadline:
+                return None
+            await mstime.sleep(self.POLL_TICK_S)
+
+    async def fetch_watermarks(
+        self, topic: str, partition: int, _timeout_s: float = 1.0
+    ) -> Tuple[int, int]:
+        wm: Watermarks = await self._conn.call(("watermarks", topic, partition))
+        return wm.low, wm.high
+
+    async def offsets_for_times(
+        self, tpl: TopicPartitionList, _timeout_s: float = 1.0
+    ) -> List[Tuple[str, int, Optional[int]]]:
+        queries = [(t, p, o or 0) for t, p, o in tpl.elements]
+        return await self._conn.call(("offsets_for_times", queries))
+
+
+class StreamConsumer(BaseConsumer):
+    """Await-forever message stream (rdkafka ``StreamConsumer::recv``)."""
+
+    async def recv(self) -> OwnedMessage:
+        while True:
+            msg = await self.poll(timeout_s=60.0)
+            if msg is not None:
+                return msg
+
+    def stream(self) -> "StreamConsumer":
+        return self
+
+    def __aiter__(self) -> "StreamConsumer":
+        return self
+
+    async def __anext__(self) -> OwnedMessage:
+        return await self.recv()
+
+
+# -- admin (sim/admin.rs) ---------------------------------------------------
+
+
+@dataclass
+class NewTopic:
+    name: str
+    num_partitions: int = 1
+
+    @staticmethod
+    def new(name: str, num_partitions: int) -> "NewTopic":
+        return NewTopic(name, num_partitions)
+
+
+class AdminClient:
+    def __init__(self, config: ClientConfig):
+        self._conn = _BrokerConn(config)
+
+    async def create_topics(self, topics: List[NewTopic]) -> List[Optional[str]]:
+        """Returns per-topic error strings (None = success), like the
+        rdkafka admin result vector."""
+        out: List[Optional[str]] = []
+        for t in topics:
+            try:
+                await self._conn.call(("create_topic", t.name, t.num_partitions))
+                out.append(None)
+            except KafkaError as e:
+                out.append(str(e))
+        return out
+
+    async def delete_topics(self, names: List[str]) -> List[Optional[str]]:
+        out: List[Optional[str]] = []
+        for name in names:
+            try:
+                await self._conn.call(("delete_topic", name))
+                out.append(None)
+            except KafkaError as e:
+                out.append(str(e))
+        return out
+
+    async def fetch_metadata(self, topic: Optional[str] = None) -> Dict[str, int]:
+        return await self._conn.call(("metadata", topic))

@@ -1,0 +1,66 @@
+"""The broker server node (madsim-rdkafka/src/sim/sim_broker.rs).
+
+``SimBroker().serve(addr)``: one request enum exchange per ``connect1``
+connection — CreateTopic / DeleteTopic / Produce / Fetch / FetchMetadata /
+FetchWatermarks / OffsetsForTimes (sim_broker.rs:14-77).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+from .. import task as mstask
+from ..context import current_handle
+from ..net.endpoint import Endpoint as NetEndpoint
+from .broker import Broker, KafkaBrokerError
+
+
+class SimBroker:
+    def __init__(self) -> None:
+        self.broker = Broker()
+
+    async def serve(self, addr: "str | tuple") -> None:
+        ep = await NetEndpoint.bind(addr)
+        while True:
+            tx, rx, _src = await ep.accept1()
+            mstask.spawn(self._serve_conn(tx, rx), name="kafka-conn")
+
+    async def _serve_conn(self, tx: Any, rx: Any) -> None:
+        try:
+            req = await rx.recv()
+            if req is None:
+                return
+            try:
+                await tx.send(("ok", self._handle(req)))
+            except KafkaBrokerError as e:
+                await tx.send(("err", str(e)))
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        finally:
+            tx.close()
+
+    def _handle(self, req: tuple) -> Any:
+        b = self.broker
+        op = req[0]
+        if op == "create_topic":
+            _, name, partitions = req
+            b.create_topic(name, partitions)
+            return None
+        if op == "delete_topic":
+            b.delete_topic(req[1])
+            return None
+        if op == "produce":
+            _, topic, partition, key, payload = req
+            ts_ms = current_handle().time.now_time_ns() // 1_000_000
+            return b.produce(topic, partition, key, payload, ts_ms)
+        if op == "fetch":
+            _, topic, partition, offset, fmax, pmax = req
+            return b.fetch(topic, partition, offset, fmax, pmax)
+        if op == "watermarks":
+            _, topic, partition = req
+            return b.watermarks(topic, partition)
+        if op == "offsets_for_times":
+            return b.offsets_for_times(req[1])
+        if op == "metadata":
+            return b.metadata(req[1])
+        raise KafkaBrokerError(f"unknown request {op!r}")

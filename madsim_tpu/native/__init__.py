@@ -1,0 +1,169 @@
+"""Native runtime core: lazy g++ build + ctypes bindings.
+
+Loads ``_simcore.so`` (building it from simcore.cpp on first import if
+needed — no pybind11 in this image, so the ABI is plain C via ctypes).
+``available()`` reports whether the native tier is usable; every consumer
+has a pure-Python fallback, and ``MADSIM_NO_NATIVE=1`` forces it off.
+
+The swap is *schedule-transparent*: the native TimerHeap orders by
+(deadline, insertion seq) exactly like the Python heapq path, and the
+ReadyQueue only executes swap-removes at indices drawn from the Python
+GlobalRng — same draws, same order, same schedules.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(__file__)
+_SRC = os.path.join(_DIR, "simcore.cpp")
+_SO = os.path.join(_DIR, "_simcore.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_load_failed = False
+
+
+def _build() -> bool:
+    try:
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _load_failed
+    if _lib is not None:
+        return _lib
+    if _load_failed or os.environ.get("MADSIM_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+        if not _build():
+            _load_failed = True  # don't re-run a failing compile per Runtime
+            return None
+    try:
+        lib = ctypes.CDLL(_SO)
+    except OSError:
+        _load_failed = True
+        return None
+    u64, i64, u32 = ctypes.c_uint64, ctypes.c_int64, ctypes.c_uint32
+    p = ctypes.POINTER
+    lib.timer_heap_new.restype = ctypes.c_void_p
+    lib.timer_heap_free.argtypes = [ctypes.c_void_p]
+    lib.timer_heap_push.argtypes = [ctypes.c_void_p, i64, u64]
+    lib.timer_heap_peek.argtypes = [ctypes.c_void_p, p(i64), p(u64)]
+    lib.timer_heap_pop.argtypes = [ctypes.c_void_p, p(i64), p(u64)]
+    lib.timer_heap_len.argtypes = [ctypes.c_void_p]
+    lib.timer_heap_len.restype = u64
+    lib.ready_queue_new.restype = ctypes.c_void_p
+    lib.ready_queue_free.argtypes = [ctypes.c_void_p]
+    lib.ready_queue_push.argtypes = [ctypes.c_void_p, u64]
+    lib.ready_queue_len.argtypes = [ctypes.c_void_p]
+    lib.ready_queue_len.restype = u64
+    lib.ready_queue_swap_remove.argtypes = [ctypes.c_void_p, u64]
+    lib.ready_queue_swap_remove.restype = u64
+    lib.threefry2x32.argtypes = [u32, u32, u32, u32, p(u32), p(u32)]
+    lib.threefry2x32_batch.argtypes = [u32, u32, p(u32), p(u32), u64]
+    _lib = lib
+    return lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+class TimerHeap:
+    """Native (deadline, seq)-ordered timer heap; callbacks stay in Python
+    keyed by the u64 id."""
+
+    __slots__ = ("_h", "_lib")
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        assert self._lib is not None, "native simcore unavailable"
+        self._h = self._lib.timer_heap_new()
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_h", None):
+            lib.timer_heap_free(self._h)
+            self._h = None
+
+    def push(self, deadline_ns: int, id: int) -> None:
+        self._lib.timer_heap_push(self._h, deadline_ns, id)
+
+    def peek(self) -> Optional[tuple]:
+        d, i = ctypes.c_int64(), ctypes.c_uint64()
+        if not self._lib.timer_heap_peek(self._h, ctypes.byref(d), ctypes.byref(i)):
+            return None
+        return d.value, i.value
+
+    def pop(self) -> Optional[tuple]:
+        d, i = ctypes.c_int64(), ctypes.c_uint64()
+        if not self._lib.timer_heap_pop(self._h, ctypes.byref(d), ctypes.byref(i)):
+            return None
+        return d.value, i.value
+
+    def __len__(self) -> int:
+        return self._lib.timer_heap_len(self._h)
+
+
+class ReadyQueue:
+    """Native swap-remove vector (ref mpsc try_recv_random)."""
+
+    __slots__ = ("_q", "_lib")
+
+    def __init__(self) -> None:
+        self._lib = _load()
+        assert self._lib is not None, "native simcore unavailable"
+        self._q = self._lib.ready_queue_new()
+
+    def __del__(self) -> None:
+        lib = getattr(self, "_lib", None)
+        if lib is not None and getattr(self, "_q", None):
+            lib.ready_queue_free(self._q)
+            self._q = None
+
+    def push(self, id: int) -> None:
+        self._lib.ready_queue_push(self._q, id)
+
+    def swap_remove(self, idx: int) -> int:
+        return self._lib.ready_queue_swap_remove(self._q, idx)
+
+    def __len__(self) -> int:
+        return self._lib.ready_queue_len(self._q)
+
+
+def threefry2x32(k0: int, k1: int, c0: int, c1: int) -> tuple:
+    """One JAX-compatible Threefry-2x32 block (for native replay of
+    device-engine draws)."""
+    lib = _load()
+    assert lib is not None, "native simcore unavailable"
+    o0, o1 = ctypes.c_uint32(), ctypes.c_uint32()
+    lib.threefry2x32(k0, k1, c0, c1, ctypes.byref(o0), ctypes.byref(o1))
+    return o0.value, o1.value
+
+
+def fold_in(k0: int, k1: int, data: int) -> tuple:
+    """jax.random.fold_in on raw key words: threefry(key, seed-words(data))."""
+    return threefry2x32(k0, k1, (data >> 32) & 0xFFFFFFFF, data & 0xFFFFFFFF)
+
+
+def random_bits(k0: int, k1: int, n: int) -> list:
+    """jax.random.bits(key, (n,), uint32) under jax_threefry_partitionable
+    (the default): word i is the XOR of the threefry output pair for
+    counter (i >> 32, i & 0xffffffff). This is the exact draw stream the
+    device engine consumes (engine/rng.py event_bits), reproduced natively."""
+    out = []
+    for i in range(n):
+        o0, o1 = threefry2x32(k0, k1, (i >> 32) & 0xFFFFFFFF, i & 0xFFFFFFFF)
+        out.append(o0 ^ o1)
+    return out

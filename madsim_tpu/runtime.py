@@ -284,10 +284,13 @@ class Runtime:
 
 
 def init_logger(level: int = logging.INFO) -> None:
-    """Install a basic logging config once (ref runtime/mod.rs:445-449)."""
+    """Install a logging config whose lines carry sim identity —
+    ``[<sim_time>s <node>/<task>]`` — once (ref runtime/mod.rs:445-449;
+    the span-per-node/task analogue lives in madsim_tpu.tracing)."""
+    from .tracing import LOG_FORMAT, SimContextFilter
+
     root = logging.getLogger()
     if not root.handlers:
-        logging.basicConfig(
-            level=level,
-            format="%(levelname)s %(name)s: %(message)s",
-        )
+        logging.basicConfig(level=level, format=LOG_FORMAT)
+        for handler in root.handlers:
+            handler.addFilter(SimContextFilter())

@@ -139,13 +139,69 @@ class NodeInfo:
         return f"<Node {self.id} {self.name!r}>"
 
 
+class _PyReadyQueue:
+    """Default ready queue: Python list with swap-remove pops."""
+
+    __slots__ = ("_items",)
+
+    def __init__(self) -> None:
+        self._items: List[Task] = []
+
+    def append(self, task: "Task") -> None:
+        self._items.append(task)
+
+    def swap_remove(self, idx: int) -> "Task":
+        items = self._items
+        task = items[idx]
+        items[idx] = items[-1]
+        items.pop()
+        return task
+
+    def __len__(self) -> int:
+        return len(self._items)
+
+
+class _NativeReadyQueue:
+    """C++ swap-remove queue (madsim_tpu.native.ReadyQueue); pop indices
+    still come from the Python GlobalRng, so schedules are identical."""
+
+    __slots__ = ("_q", "_tasks")
+
+    def __init__(self) -> None:
+        from .native import ReadyQueue
+
+        self._q = ReadyQueue()
+        self._tasks: Dict[int, Task] = {}
+
+    def append(self, task: "Task") -> None:
+        self._tasks[task.id] = task
+        self._q.push(task.id)
+
+    def swap_remove(self, idx: int) -> "Task":
+        return self._tasks.pop(self._q.swap_remove(idx))
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+
+def _make_ready_queue():
+    import os
+
+    if os.environ.get("MADSIM_NATIVE"):
+        from . import native
+
+        if native.available():
+            return _NativeReadyQueue()
+    return _PyReadyQueue()
+
+
 class Executor:
     """The deterministic event loop (ref ``Executor``, task/mod.rs:43-317)."""
 
     def __init__(self, rng: GlobalRng, time: TimeHandle):
         self.rng = rng
         self.time = time
-        self.ready: List[Task] = []
+        self.ready = _make_ready_queue()
         self.nodes: Dict[NodeId, NodeInfo] = {}
         self._next_node_id = 1
         self._next_task_id = 1
@@ -216,12 +272,10 @@ class Executor:
         (ref ``run_all_ready``, task/mod.rs:263-316)."""
         ready = self.ready
         rng = self.rng
-        while ready:
+        while len(ready):
             # random swap-remove pop (ref sim/utils/mpsc.rs:73-83)
             idx = rng.gen_range(0, len(ready))
-            task = ready[idx]
-            ready[idx] = ready[-1]
-            ready.pop()
+            task = ready.swap_remove(idx)
             task.scheduled = False
             if task.finished:
                 continue

@@ -86,6 +86,83 @@ class _TimerEntry:
         self.cancelled = True
 
 
+class _PyTimerQueue:
+    """Default timer queue: heapq of (deadline, seq, entry)."""
+
+    __slots__ = ("_heap", "_seq")
+
+    def __init__(self) -> None:
+        self._heap: List[Tuple[int, int, _TimerEntry]] = []
+        self._seq = 0
+
+    def push(self, entry: _TimerEntry) -> None:
+        self._seq += 1
+        heapq.heappush(self._heap, (entry.deadline_ns, self._seq, entry))
+
+    def peek(self) -> Optional[_TimerEntry]:
+        while self._heap:
+            _d, _s, entry = self._heap[0]
+            if entry.cancelled:
+                heapq.heappop(self._heap)
+                continue
+            return entry
+        return None
+
+    def pop(self) -> Optional[_TimerEntry]:
+        entry = self.peek()
+        if entry is not None:
+            heapq.heappop(self._heap)
+        return entry
+
+
+class _NativeTimerQueue:
+    """Native C++ heap backend (madsim_tpu.native.TimerHeap) — identical
+    (deadline, insertion-seq) ordering, selected with MADSIM_NATIVE=1."""
+
+    __slots__ = ("_heap", "_entries", "_next_id")
+
+    def __init__(self) -> None:
+        from .native import TimerHeap
+
+        self._heap = TimerHeap()
+        self._entries: dict = {}
+        self._next_id = 0
+
+    def push(self, entry: _TimerEntry) -> None:
+        self._next_id += 1
+        self._entries[self._next_id] = entry
+        self._heap.push(entry.deadline_ns, self._next_id)
+
+    def peek(self) -> Optional[_TimerEntry]:
+        while True:
+            top = self._heap.peek()
+            if top is None:
+                return None
+            entry = self._entries[top[1]]
+            if entry.cancelled:
+                self._heap.pop()
+                del self._entries[top[1]]
+                continue
+            return entry
+
+    def pop(self) -> Optional[_TimerEntry]:
+        if self.peek() is None:
+            return None
+        _d, id = self._heap.pop()
+        return self._entries.pop(id)
+
+
+def _make_timer_queue():
+    import os
+
+    if os.environ.get("MADSIM_NATIVE"):
+        from . import native
+
+        if native.available():
+            return _NativeTimerQueue()
+    return _PyTimerQueue()
+
+
 class TimeHandle:
     """Virtual clock + binary-heap timer queue (time/mod.rs:21-230)."""
 
@@ -97,8 +174,7 @@ class TimeHandle:
             + rng.gen_range(0, 365 * 24 * 3600) * NANOS_PER_SEC
         )
         self._clock_ns = 0  # monotonic ns since sim start
-        self._heap: List[Tuple[int, int, _TimerEntry]] = []
-        self._seq = 0  # FIFO tie-break for equal deadlines
+        self._q = _make_timer_queue()
         rng._now_ns = lambda: self._clock_ns
 
     # -- clocks -----------------------------------------------------------
@@ -125,8 +201,7 @@ class TimeHandle:
         """Register a callback at an absolute monotonic deadline
         (``TimeHandle::add_timer_at``, time/mod.rs:142-153)."""
         entry = _TimerEntry(deadline_ns, callback)
-        self._seq += 1
-        heapq.heappush(self._heap, (deadline_ns, self._seq, entry))
+        self._q.push(entry)
         return entry
 
     def add_timer_ns(self, delay_ns: int, callback: Callable[[], None]) -> _TimerEntry:
@@ -136,24 +211,16 @@ class TimeHandle:
         return self.add_timer_ns(_to_ns(delay_s), callback)
 
     def next_deadline_ns(self) -> Optional[int]:
-        while self._heap:
-            deadline, _seq, entry = self._heap[0]
-            if entry.cancelled:
-                heapq.heappop(self._heap)
-                continue
-            return deadline
-        return None
+        entry = self._q.peek()
+        return entry.deadline_ns if entry is not None else None
 
     def _fire_due(self) -> int:
         fired = 0
-        while self._heap:
-            deadline, _seq, entry = self._heap[0]
-            if entry.cancelled:
-                heapq.heappop(self._heap)
-                continue
-            if deadline > self._clock_ns:
+        while True:
+            entry = self._q.peek()
+            if entry is None or entry.deadline_ns > self._clock_ns:
                 break
-            heapq.heappop(self._heap)
+            self._q.pop()
             entry.callback()
             fired += 1
         return fired

@@ -1,0 +1,139 @@
+"""Async-runtime façade — the madsim-tokio analogue.
+
+The reference ships a tokio drop-in that re-exports the simulator's
+net/time/task/signal, keeps the runtime-agnostic pieces (sync primitives,
+macros), and fakes ``runtime::{Builder, Runtime, Handle}`` — ``Runtime``
+collects the abort handles of everything it spawned and aborts them all on
+shutdown, while ``block_on`` inside a simulation is a hard error
+(madsim-tokio/src/lib.rs:38-50, sim/runtime.rs:51-112).
+
+Users porting tokio-shaped Python code get the same shape:
+
+    from madsim_tpu import tokio
+    rt = tokio.runtime.Builder().build()
+    rt.spawn(worker())          # tracked; aborted on rt.shutdown()
+    await tokio.time.sleep(1.0)
+    tx, rx = tokio.sync.channel(16)
+"""
+
+from __future__ import annotations
+
+from typing import Any, Coroutine, List, Optional
+
+# re-exports, mirroring the façade's module layout (lib.rs:38-50)
+from . import fs as fs
+from . import net as net
+from . import signal as signal
+from . import sync as sync
+from . import task as task
+from . import time as time
+from .futures import JoinHandle, join, select
+from .task import spawn, spawn_local
+from .time import interval, sleep, sleep_until, timeout
+
+
+class runtime:
+    """Namespace mirroring ``tokio::runtime``."""
+
+    class Builder:
+        """Accepts-and-ignores the threading knobs (a simulation is
+        single-threaded by construction), builds a tracking Runtime."""
+
+        def __init__(self) -> None:
+            pass
+
+        @staticmethod
+        def new_multi_thread() -> "runtime.Builder":
+            return runtime.Builder()
+
+        @staticmethod
+        def new_current_thread() -> "runtime.Builder":
+            return runtime.Builder()
+
+        def worker_threads(self, _n: int) -> "runtime.Builder":
+            return self
+
+        def thread_name(self, _name: str) -> "runtime.Builder":
+            return self
+
+        def thread_stack_size(self, _n: int) -> "runtime.Builder":
+            return self
+
+        def enable_all(self) -> "runtime.Builder":
+            return self
+
+        def enable_time(self) -> "runtime.Builder":
+            return self
+
+        def enable_io(self) -> "runtime.Builder":
+            return self
+
+        def build(self) -> "runtime.Runtime":
+            return runtime.Runtime()
+
+    class Runtime:
+        """Spawn-tracking runtime: every task spawned through it is
+        aborted when the runtime shuts down (sim/runtime.rs:51-112)."""
+
+        def __init__(self) -> None:
+            self._handles: List[JoinHandle] = []
+            self._closed = False
+
+        def spawn(self, coro: Coroutine[Any, Any, Any],
+                  name: Optional[str] = None) -> JoinHandle:
+            if self._closed:
+                coro.close()
+                raise RuntimeError("runtime has been shut down")
+            handle = spawn(coro, name=name)
+            if len(self._handles) >= 64:
+                self._handles = [h for h in self._handles if not h.done()]
+            self._handles.append(handle)
+            return handle
+
+        def block_on(self, _coro: Any) -> Any:
+            raise RuntimeError(
+                "cannot block_on inside a simulation — spawn the future or "
+                "await it (the reference's sim tokio Runtime::block_on is "
+                "unimplemented!(), sim/runtime.rs:91-93)"
+            )
+
+        def handle(self) -> "runtime.Runtime":
+            return self
+
+        def shutdown(self) -> None:
+            """Abort everything this runtime spawned (Drop impl)."""
+            self._closed = True
+            handles, self._handles = self._handles, []
+            for h in handles:
+                h.abort()
+
+        shutdown_background = shutdown
+        shutdown_timeout = lambda self, _t: self.shutdown()  # noqa: E731
+
+        def __enter__(self) -> "runtime.Runtime":
+            return self
+
+        def __exit__(self, *_exc: Any) -> None:
+            self.shutdown()
+
+    Handle = Runtime
+
+
+__all__ = [
+    "JoinHandle",
+    "fs",
+    "interval",
+    "join",
+    "net",
+    "runtime",
+    "select",
+    "signal",
+    "sleep",
+    "sleep_until",
+    "spawn",
+    "spawn_local",
+    "sync",
+    "task",
+    "time",
+    "timeout",
+]

@@ -1,0 +1,305 @@
+"""Kafka sim tests — port of madsim-rdkafka/tests/test.rs (176 lines):
+broker node + admin + producers + consumers over sim DNS, plus fetch
+budgets, watermarks, offsets-for-times, seek, and broker crash/restart.
+"""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.kafka import (
+    AdminClient,
+    BaseConsumer,
+    BaseProducer,
+    BaseRecord,
+    ClientConfig,
+    FutureProducer,
+    KafkaError,
+    NewTopic,
+    SimBroker,
+    StreamConsumer,
+    TopicPartitionList,
+)
+from madsim_tpu.net import NetSim
+from madsim_tpu.plugin import simulator
+
+BROKER = "10.0.0.1:9092"
+
+
+def with_broker(seed, client_fn):
+    rt = ms.Runtime(seed=seed)
+
+    async def main():
+        h = ms.current_handle()
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve(BROKER)
+        ).build()
+        node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+        return await node.spawn(client_fn())
+
+    return rt.block_on(main())
+
+
+def cfg() -> ClientConfig:
+    return ClientConfig().set("bootstrap.servers", BROKER)
+
+
+def test_produce_consume_round_robin():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        errs = await admin.create_topics([NewTopic.new("t", 3)])
+        assert errs == [None]
+        # duplicate create reports an error string
+        errs = await admin.create_topics([NewTopic.new("t", 3)])
+        assert errs[0] is not None
+
+        producer = await cfg().create(FutureProducer)
+        parts = set()
+        for i in range(6):
+            partition, offset = await producer.send(
+                BaseRecord.to("t").with_payload(f"m{i}")
+            )
+            parts.add(partition)
+        # keyless produce round-robins over all 3 partitions (broker.rs:80-101)
+        assert parts == {0, 1, 2}
+
+        consumer = await cfg().create(BaseConsumer)
+        await consumer.subscribe(["t"])
+        got = set()
+        for _ in range(6):
+            msg = await consumer.poll(1.0)
+            assert msg is not None
+            got.add(msg.payload.decode())
+        assert got == {f"m{i}" for i in range(6)}
+        assert await consumer.poll(0.1) is None
+
+    with_broker(41, run)
+
+
+def test_keyed_produce_is_sticky():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("t", 4)])
+        producer = await cfg().create(FutureProducer)
+        parts = {
+            (await producer.send(BaseRecord.to("t").with_key("k1").with_payload(str(i))))[0]
+            for i in range(5)
+        }
+        assert len(parts) == 1  # same key → same partition
+
+    with_broker(42, run)
+
+
+def test_base_producer_buffers_until_flush():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("t", 1)])
+        producer = await cfg().create(BaseProducer)
+        consumer = await cfg().create(BaseConsumer)
+        await consumer.subscribe(["t"])
+        producer.send(BaseRecord.to("t").with_payload("a"))
+        producer.send(BaseRecord.to("t").with_payload("b"))
+        assert producer.in_flight_count() == 2
+        assert await consumer.poll(0.1) is None  # nothing until flush
+        await producer.flush()
+        assert (await consumer.poll(1.0)).payload == b"a"
+        assert (await consumer.poll(1.0)).payload == b"b"
+
+    with_broker(43, run)
+
+
+def test_watermarks_seek_offsets_for_times():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("t", 1)])
+        producer = await cfg().create(FutureProducer)
+        t_mid = None
+        for i in range(5):
+            if i == 3:
+                await ms.sleep(5)
+                t_mid = int(ms.time.now() * 1000)
+            await producer.send(BaseRecord.to("t").with_payload(f"m{i}"))
+        consumer = await cfg().create(BaseConsumer)
+        lo, hi = await consumer.fetch_watermarks("t", 0)
+        assert (lo, hi) == (0, 5)
+        # offsets_for_times finds the first message at/after t_mid
+        tpl = TopicPartitionList().add_partition_offset("t", 0, t_mid)
+        [(_, _, off)] = await consumer.offsets_for_times(tpl)
+        assert off == 3
+        # assign + seek replays from there
+        await consumer.assign(TopicPartitionList().add_partition("t", 0))
+        consumer.seek("t", 0, off)
+        assert (await consumer.poll(1.0)).payload == b"m3"
+
+    with_broker(44, run)
+
+
+def test_fetch_byte_budget():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("t", 1)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(10):
+            await producer.send(BaseRecord.to("t").with_payload(b"x" * 100))
+        # max.partition.fetch.bytes of 250 → ~3 messages per fetch round
+        consumer = await (
+            cfg().set("max.partition.fetch.bytes", 250).create(BaseConsumer)
+        )
+        await consumer.subscribe(["t"])
+        for _ in range(10):
+            assert (await consumer.poll(1.0)) is not None
+        assert await consumer.poll(0.05) is None
+
+    with_broker(45, run)
+
+
+def test_stream_consumer_and_linger():
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("t", 2)])
+        consumer = await cfg().create(StreamConsumer)
+        await consumer.subscribe(["t"])
+
+        async def produce_later():
+            producer = await (cfg().set("linger.ms", 50).create(FutureProducer))
+            await ms.sleep(1.0)
+            await producer.send(BaseRecord.to("t").with_payload("late"))
+
+        ms.spawn(produce_later())
+        t0 = ms.time.elapsed()
+        msg = await consumer.recv()
+        assert msg.payload == b"late"
+        assert ms.time.elapsed() - t0 >= 1.0  # waited on virtual time
+
+    with_broker(46, run)
+
+
+def test_broker_crash_restart():
+    rt = ms.Runtime(seed=47)
+
+    async def main():
+        h = ms.current_handle()
+        broker = h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve(BROKER)
+        ).build()
+        node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            admin = await cfg().create(AdminClient)
+            await admin.create_topics([NewTopic.new("t", 1)])
+            producer = await cfg().create(FutureProducer)
+            await producer.send(BaseRecord.to("t").with_payload("pre"))
+            h.kill(broker)
+            with pytest.raises(KafkaError):
+                await producer.send(BaseRecord.to("t").with_payload("down"))
+            h.restart(broker)
+            await ms.sleep(0.2)
+            # broker state is volatile (fresh on restart, like the ref sim)
+            with pytest.raises(KafkaError, match="unknown topic"):
+                await producer.send(BaseRecord.to("t").with_payload("post"))
+            await admin.create_topics([NewTopic.new("t", 1)])
+            partition, offset = await producer.send(
+                BaseRecord.to("t").with_payload("post")
+            )
+            assert (partition, offset) == (0, 0)
+
+        await node.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_two_producers_two_consumers_topology():
+    """The reference's flagship topology (tests/test.rs:21-100): admin +
+    2 producers + 2 consumers on separate nodes over sim DNS."""
+    rt = ms.Runtime(seed=48)
+
+    async def main():
+        h = ms.current_handle()
+        h.create_node().name("broker").ip("10.0.0.1").init(
+            lambda: SimBroker().serve(BROKER)
+        ).build()
+        await ms.sleep(0.1)
+        simulator(NetSim).add_dns_record("kafka-broker", "10.0.0.1")
+        dns_cfg = ClientConfig().set("bootstrap.servers", "kafka-broker:9092")
+
+        admin_node = h.create_node().name("admin").ip("10.0.0.2").build()
+
+        async def setup():
+            admin = await dns_cfg.create(AdminClient)
+            assert (await admin.create_topics([NewTopic.new("events", 4)])) == [None]
+
+        await admin_node.spawn(setup())
+
+        results = []
+
+        def producer_init(tag):
+            def make():
+                async def run():
+                    p = await dns_cfg.create(FutureProducer)
+                    for i in range(10):
+                        await p.send(
+                            BaseRecord.to("events").with_payload(f"{tag}-{i}")
+                        )
+                        await ms.sleep(0.01)
+
+                return run()
+
+            return make
+
+        h.create_node().name("p1").ip("10.0.0.3").init(producer_init("p1")).build()
+        h.create_node().name("p2").ip("10.0.0.4").init(producer_init("p2")).build()
+
+        async def consume(partitions):
+            c = await dns_cfg.create(BaseConsumer)
+            tpl = TopicPartitionList()
+            for p in partitions:
+                tpl.add_partition("events", p)
+            await c.assign(tpl)
+            while True:
+                msg = await c.poll(2.0)
+                if msg is None:
+                    return
+                results.append(msg.payload.decode())
+
+        c1 = h.create_node().name("c1").ip("10.0.0.5").build()
+        c2 = h.create_node().name("c2").ip("10.0.0.6").build()
+        t1 = c1.spawn(consume([0, 1]))
+        t2 = c2.spawn(consume([2, 3]))
+        await t1
+        await t2
+        assert sorted(results) == sorted(
+            [f"p{j}-{i}" for j in (1, 2) for i in range(10)]
+        )
+
+    rt.block_on(main())
+
+
+def test_kafka_determinism():
+    def workload():
+        async def main():
+            h = ms.current_handle()
+            h.create_node().name("broker").ip("10.0.0.1").init(
+                lambda: SimBroker().serve(BROKER)
+            ).build()
+            node = h.create_node().name("client").ip("10.0.0.2").build()
+            await ms.sleep(0.1)
+
+            async def run():
+                admin = await cfg().create(AdminClient)
+                await admin.create_topics([NewTopic.new("t", 2)])
+                producer = await cfg().create(FutureProducer)
+                for i in range(8):
+                    await producer.send(BaseRecord.to("t").with_payload(f"m{i}"))
+                consumer = await cfg().create(BaseConsumer)
+                await consumer.subscribe(["t"])
+                n = 0
+                while await consumer.poll(0.2) is not None:
+                    n += 1
+                assert n == 8
+
+            await node.spawn(run())
+
+        return main()
+
+    ms.Runtime.check_determinism(49, workload)
