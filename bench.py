@@ -21,7 +21,9 @@ import time as walltime
 
 SIM_SECONDS = 3.0
 HOST_SEEDS = 8
-DEVICE_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 2048
+# large default batch: the lockstep engine amortizes per-op dispatch over
+# the seed axis, so throughput grows with batch size
+DEVICE_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
 
 
 def bench_host() -> float:
@@ -46,13 +48,20 @@ def bench_device() -> tuple:
     cfg = raft.RaftConfig(num_nodes=5, crashes=1)
     ecfg = raft.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
     wl = raft.workload(cfg)
-    seeds = jnp.arange(DEVICE_SEEDS, dtype=jnp.int64)
 
-    # warmup = compile (cached for the timed run)
-    jax.block_until_ready(core.run_sweep(wl, ecfg, seeds))
+    # warmup = compile; MUST use different seeds than the timed run (the
+    # runtime memoizes same-input executions, which silently produces
+    # fantasy numbers)
+    warm = core.run_sweep(
+        wl, ecfg, jnp.arange(DEVICE_SEEDS, 2 * DEVICE_SEEDS, dtype=jnp.int64)
+    )
+    int(warm.ctr.sum())  # force full materialization of the warmup
+    seeds = jnp.arange(DEVICE_SEEDS, dtype=jnp.int64)
     t0 = walltime.perf_counter()
     final = core.run_sweep(wl, ecfg, seeds)
-    jax.block_until_ready(final)
+    # time to host readback — block_until_ready alone under-reports on
+    # asynchronously tunneled devices
+    int(final.ctr.sum())
     dt = walltime.perf_counter() - t0
     return DEVICE_SEEDS / dt, raft.sweep_summary(final), dt
 

@@ -122,25 +122,27 @@ def init_sweep(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> Eng
 
 
 def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineState:
-    """Advance one seed by one event (no-op once ``done``)."""
-    q, t, kind, pay, found = equeue.pop_min(s.queue)
+    """Advance one seed by one event (no-op once ``done``).
+
+    Three masks compose: already-done seeds freeze entirely; a
+    popped-empty queue or expired clock marks done without dispatching;
+    only ``take`` applies the handler's writes. Queue mutations are gated
+    at the scatter level (pop ``enable`` / push ``enables``) so the big
+    [Q]-sized arrays never need a whole-array select; only the workload
+    state goes through a select tree."""
+    active = ~s.done
+    q, t, kind, pay, found = equeue.pop_min(s.queue, enable=active)
     rand = event_bits(s.key, s.ctr, workload.num_rand + 1)
     jitter = bounded(rand[0], cfg.jitter_lo_ns, cfg.jitter_hi_ns + 1)
     now = jnp.maximum(s.now_ns, t) + jitter
     time_up = now > cfg.time_limit_ns
     dispatch = found & ~time_up
+    take = active & dispatch
 
     wstate, emits = workload.handle(s.wstate, now, kind, pay, rand[1:])
     q, ov = equeue.push_many(
-        q, emits.times, emits.kinds, emits.pays, emits.enables & dispatch
+        q, emits.times, emits.kinds, emits.pays, emits.enables & take
     )
-
-    # Select between the advanced and untouched state. Three masks compose:
-    # already-done seeds freeze entirely; a popped-empty queue or expired
-    # clock marks done without dispatching; only `dispatch` applies the
-    # handler's writes.
-    active = ~s.done
-    take = active & dispatch
 
     def sel(pred, new, old):
         return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
@@ -152,7 +154,7 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         ctr=jnp.where(take, s.ctr + 1, s.ctr),
         done=s.done | (active & (~found | time_up)),
         overflow=s.overflow | (take & ov),
-        queue=sel(take, q, s.queue),
+        queue=q,
         wstate=sel(take, wstate, s.wstate),
     )
 

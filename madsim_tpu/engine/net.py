@@ -62,6 +62,21 @@ def route(
     return now_ns + latency, ~(clogged | lost)
 
 
+def route_from(
+    links: LinkState,
+    now_ns: jnp.ndarray,
+    src: jnp.ndarray,
+    u_loss: jnp.ndarray,  # uint32[N]
+    u_lat: jnp.ndarray,  # uint32[N]
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Vectorized ``route`` for a broadcast: link-test src→every node at
+    once. Returns ``(deliver_times[N], deliver[N])``."""
+    clogged = links.clog[src, :]
+    lost = coin(u_loss, links.loss_q32)
+    latency = bounded(u_lat, links.lat_lo_ns, links.lat_hi_ns + 1)
+    return now_ns + latency, ~(clogged | lost)
+
+
 def clog_node(links: LinkState, node: jnp.ndarray) -> LinkState:
     """Clog both directions of a node (ref ``NetSim::clog_node``)."""
     n = links.clog.shape[0]

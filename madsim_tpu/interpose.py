@@ -17,7 +17,7 @@ handle) — the same property the reference gets from thread-local context.
 Intercepted:  ``random.*`` (module-level functions), ``os.urandom``,
 ``uuid.uuid4``, ``time.{time,time_ns,monotonic,monotonic_ns,perf_counter,
 perf_counter_ns}``, ``threading.Thread.start`` (blocked in sim unless
-allowed).  Known gap (documented): ``datetime.datetime.now`` reads the OS
+allowed), ``os.cpu_count`` (reports the node's configured cores).  Known gap (documented): ``datetime.datetime.now`` reads the OS
 clock from C and cannot be patched — use ``madsim_tpu.time.now()``.
 """
 
@@ -145,6 +145,18 @@ def _sim_thread_start(self: threading.Thread, *args: Any, **kwargs: Any) -> Any:
     return _originals["threading.Thread.start"](self, *args, **kwargs)
 
 
+def _sim_cpu_count() -> Any:
+    """Inside a sim task, report the node's configured cores — the
+    analogue of the reference faking ``available_parallelism`` via
+    ``sched_getaffinity``/``sysconf`` (task/mod.rs:707-760)."""
+    from . import context
+
+    task = context.try_current_task()
+    if task is None:
+        return _originals["os.cpu_count"]()
+    return task.node.cores
+
+
 def _install() -> None:
     import random as _r
     import time as _t
@@ -168,8 +180,10 @@ def _install() -> None:
             "time.perf_counter": _t.perf_counter,
             "time.perf_counter_ns": _t.perf_counter_ns,
             "threading.Thread.start": threading.Thread.start,
+            "os.cpu_count": os.cpu_count,
         }
     )
+    os.cpu_count = _sim_cpu_count
     _r.random = _SimRandomDispatch.random
     _r.getrandbits = _SimRandomDispatch.getrandbits
     _r.randbytes = _SimRandomDispatch.randbytes
@@ -210,6 +224,7 @@ def _uninstall() -> None:
     _t.perf_counter = _originals["time.perf_counter"]
     _t.perf_counter_ns = _originals["time.perf_counter_ns"]
     threading.Thread.start = _originals["threading.Thread.start"]
+    os.cpu_count = _originals["os.cpu_count"]
     _originals.clear()
 
 

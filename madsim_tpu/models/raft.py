@@ -1,28 +1,40 @@
-"""Raft leader election as a device workload (the MadRaft sweep).
+"""Raft (election + log replication) as a device workload — the MadRaft
+sweep.
 
-This is the flagship model for the engine: an N-node Raft cluster (election
-+ heartbeats, Ongaro & Ousterhout §5.2) with crash/restart fault injection
-and per-message loss/latency, expressed as pure array handlers so thousands
-of seeds run in lockstep on TPU. It plays the role the MadRaft test suite
-plays for the reference (BASELINE.md configs #3/#5): randomized schedules +
-faults hunting for election-safety violations, with every found seed
-replayable bit-exactly on CPU via ``engine.run_traced``.
+This is the flagship model for the engine: an N-node Raft cluster — leader
+election with the §5.4.1 vote restriction, single-entry AppendEntries
+replication with consistency checks and next/match-index bookkeeping, and
+commit advancement under the §5.4.2 current-term rule (Ongaro & Ousterhout)
+— with crash/restart fault injection and per-message loss/latency,
+expressed as pure array handlers so thousands of seeds run in lockstep on
+TPU. It plays the role the MadRaft test suite plays for the reference
+(BASELINE.md configs #3/#5): randomized schedules + faults hunting for
+safety violations, with every found seed replayable bit-exactly on CPU via
+``engine.run_traced``.
+
+Two safety invariants are checked online, any breach latches ``violation``:
+- **election safety**: at most one leader per term (a (term, winner) ring
+  compared on every won election);
+- **log matching at commit**: the first node to commit index i records
+  the entry term; every later commit of i must agree.
 
 Mechanics mirrored from the reference simulator rather than any Raft
 implementation: message delivery = link test + latency draw
 (madsim/src/sim/net/network.rs:261-269), node crash/restart semantics =
-kill/restart with durable vs volatile state
-(madsim/src/sim/task/mod.rs:347-394), randomized timers = the virtual-clock
-timer queue (madsim/src/sim/time/mod.rs:142-153).
+kill/restart with durable (term, vote, log) vs volatile (role, votes,
+commit) state (madsim/src/sim/task/mod.rs:347-394), randomized timers =
+the virtual-clock timer queue (madsim/src/sim/time/mod.rs:142-153).
 
 Design notes:
 - Timer staleness uses generation counters (``tgen`` per node for election
-  timers, ``lepoch`` per node for heartbeat timers) instead of timer
-  cancellation — the queue is append-only per event, cancellation is a
-  pay-mismatch drop, which costs nothing in lockstep.
-- Election safety is checked online: every won election is recorded in a
-  small (term, node) ring; a second winner of an already-recorded term
-  raises the sticky ``violation`` flag.
+  timers, ``lepoch`` for heartbeat timers) instead of cancellation — the
+  queue is append-only, cancellation is a pay-mismatch drop.
+- Replication ships ONE entry per AppendEntries (the follower's
+  next-index entry), so message payloads stay fixed-width; heartbeats are
+  empty appends. Leaders retry/decrement on rejection — the classic loop.
+- Logs are bounded arrays (``log_cap`` entries); a seed whose log would
+  overflow latches ``log_overflow`` and stops appending (surfaced in the
+  sweep summary, never silent).
 """
 
 from __future__ import annotations
@@ -36,26 +48,27 @@ import jax.numpy as jnp
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.rng import bounded, prob_to_q32
-from ..engine.queue import INVALID_TIME
 
 # event kinds
 K_ELECTION = 0  # pay = (node, tgen)
 K_HEARTBEAT = 1  # pay = (node, lepoch)
-K_MSG = 2  # pay = (dst, mtype, src, term)
+K_MSG = 2  # pay = (dst, mtype, src, term, a, b, c, d)
 K_CRASH = 3  # pay = (node,)
 K_RESTART = 4  # pay = (node,)
+K_CMD = 5  # pay = (target, retries) — a client command seeking the leader
 
 # message types
-M_REQ_VOTE = 0
+M_REQ_VOTE = 0  # a=last_log_idx, b=last_log_term
 M_VOTE_GRANT = 1
-M_APPEND = 2
+M_APPEND = 2  # a=prev_idx, b=prev_term, c=entry_term (0 = heartbeat), d=commit
+M_APPEND_RSP = 3  # a=success, b=match_idx
 
 # roles
 FOLLOWER = 0
 CANDIDATE = 1
 LEADER = 2
 
-PAYLOAD_SLOTS = 4
+PAYLOAD_SLOTS = 8
 
 
 class RaftConfig(NamedTuple):
@@ -65,6 +78,12 @@ class RaftConfig(NamedTuple):
     election_lo_ns: int = 150_000_000
     election_hi_ns: int = 300_000_000
     heartbeat_ns: int = 50_000_000
+    # client command plan: `commands` K_CMD events in the first
+    # `cmd_window_ns`, retrying every retry_ns until a leader accepts
+    commands: int = 8
+    cmd_window_ns: int = 4_000_000_000
+    cmd_retry_ns: int = 50_000_000
+    log_cap: int = 32
     # fault plan: `crashes` node-crash events at random times in the first
     # `crash_window_ns`, each restarting after a random delay
     crashes: int = 2
@@ -79,15 +98,21 @@ class RaftConfig(NamedTuple):
 
 
 class RaftState(NamedTuple):
-    # per-node Raft state [N]
+    # per-node Raft state [N] (term/voted/log are durable across crashes)
     role: jnp.ndarray  # int32
     term: jnp.ndarray  # int32
-    voted: jnp.ndarray  # int32, -1 = none (durable)
+    voted: jnp.ndarray  # int32, -1 = none
     votes: jnp.ndarray  # uint32 bitmask of granted votes
     alive: jnp.ndarray  # bool
-    last_hb: jnp.ndarray  # int64, last time a valid leader/grant was heard
+    last_hb: jnp.ndarray  # int64, last time a valid leader signal arrived
     tgen: jnp.ndarray  # int32 election-timer generation
     lepoch: jnp.ndarray  # int32 leadership epoch (heartbeat-timer guard)
+    # replicated log [N, L]: term of each entry; slot 0 is the sentinel
+    log_term: jnp.ndarray  # int32[N, L]
+    log_len: jnp.ndarray  # int32[N] (== last used index; entries 1..len)
+    commit: jnp.ndarray  # int32[N] (volatile)
+    next_idx: jnp.ndarray  # int32[N, N] (leader bookkeeping, volatile)
+    match_idx: jnp.ndarray  # int32[N, N]
     # network
     links: enet.LinkState
     # election-safety ring [H]
@@ -95,9 +120,15 @@ class RaftState(NamedTuple):
     hist_node: jnp.ndarray  # int32
     hist_valid: jnp.ndarray  # bool
     hist_pos: jnp.ndarray  # int32
+    # log-matching-at-commit checker [L]
+    chist_term: jnp.ndarray  # int32
+    chist_set: jnp.ndarray  # bool
     # sweep outputs
     violation: jnp.ndarray  # bool
+    log_overflow: jnp.ndarray  # bool
     elections: jnp.ndarray  # int32
+    commits: jnp.ndarray  # int32 (total commit-index advancement)
+    accepted_cmds: jnp.ndarray  # int32
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
 
@@ -107,25 +138,6 @@ def _pay(*vals, slots: int = PAYLOAD_SLOTS) -> jnp.ndarray:
     for i, v in enumerate(vals):
         out = out.at[i].set(jnp.asarray(v, jnp.int32))
     return out
-
-
-def _broadcast(cfg: RaftConfig, w: RaftState, now, src, mtype, term, rand, enable):
-    """Emit slots 0..N-1: one message per destination node (self slot
-    disabled), each individually link-tested (loss/clog/latency draws)."""
-    n = cfg.num_nodes
-    times = jnp.zeros((n,), jnp.int64)
-    kinds = jnp.full((n,), K_MSG, jnp.int32)
-    pays = jnp.zeros((n, PAYLOAD_SLOTS), jnp.int32)
-    enables = jnp.zeros((n,), bool)
-    for i in range(n):
-        t, deliver = enet.route(w.links, now, src, jnp.int32(i), rand[2 * i], rand[2 * i + 1])
-        on = enable & (i != src) & deliver
-        times = times.at[i].set(t)
-        pays = pays.at[i].set(_pay(i, mtype, src, term))
-        enables = enables.at[i].set(on)
-    sent = jnp.where(enable, jnp.int32(cfg.num_nodes - 1), 0)
-    delivered = jnp.sum(enables, dtype=jnp.int32)
-    return times, kinds, pays, enables, sent, delivered
 
 
 _DISABLED_EXTRA = None  # sentinel: an unused extra slot
@@ -166,6 +178,32 @@ def _no_bcast(cfg: RaftConfig):
     )
 
 
+def _pays(cfg: RaftConfig, mtype, src, term, a=0, b=0, c=0, d=0) -> jnp.ndarray:
+    """[N, P] message payloads addressed to every node; each field is a
+    scalar (broadcast) or an [N] vector (per-destination)."""
+    n = cfg.num_nodes
+    dst = jnp.arange(n, dtype=jnp.int32)
+
+    def col(v):
+        return jnp.broadcast_to(jnp.asarray(v, jnp.int32), (n,))
+
+    cols = [dst, col(mtype), col(src), col(term), col(a), col(b), col(c), col(d)]
+    return jnp.stack(cols, axis=1)
+
+
+def _broadcast(cfg: RaftConfig, w: RaftState, now, src, rand, enable, pays):
+    """Emit slots 0..N-1: one message per destination (self slot disabled),
+    each individually link-tested — all vectorized, no per-node loop."""
+    n = cfg.num_nodes
+    u = rand[: 2 * n].reshape(n, 2)
+    times, deliver = enet.route_from(w.links, now, src, u[:, 0], u[:, 1])
+    enables = enable & (jnp.arange(n, dtype=jnp.int32) != src) & deliver
+    kinds = jnp.full((n,), K_MSG, jnp.int32)
+    sent = jnp.where(enable, jnp.int32(cfg.num_nodes - 1), 0)
+    delivered = jnp.sum(enables, dtype=jnp.int32)
+    return (times, kinds, pays, enables), sent, delivered
+
+
 def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
     """Online election-safety check: a term may elect at most one leader."""
     dup = jnp.any(w.hist_valid & (w.hist_term == term) & (w.hist_node != node))
@@ -177,6 +215,38 @@ def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
         hist_valid=w.hist_valid.at[slot].set(w.hist_valid[slot] | won),
         hist_pos=jnp.where(won, w.hist_pos + 1, w.hist_pos),
         elections=jnp.where(won, w.elections + 1, w.elections),
+    )
+
+
+def _advance_commit(cfg: RaftConfig, w: RaftState, node, new_commit, enable):
+    """Move ``commit[node]`` to ``new_commit`` and run the log-matching
+    checker over the newly committed range."""
+    old = w.commit[node]
+    new = jnp.where(enable, jnp.maximum(old, new_commit.astype(jnp.int32)), old)
+    idx = jnp.arange(cfg.log_cap, dtype=jnp.int32)
+    fresh = (idx > old) & (idx <= new)
+    my_terms = w.log_term[node]
+    mismatch = jnp.any(fresh & w.chist_set & (w.chist_term != my_terms))
+    return w._replace(
+        commit=w.commit.at[node].set(new),
+        chist_term=jnp.where(fresh & ~w.chist_set, my_terms, w.chist_term),
+        chist_set=w.chist_set | fresh,
+        violation=w.violation | mismatch,
+        commits=w.commits + (new - old).astype(jnp.int32),
+    )
+
+
+def _append_pays(cfg: RaftConfig, w: RaftState, leader, term) -> jnp.ndarray:
+    """AppendEntries payloads [N, P]: each follower gets the entry at its
+    next-index (or a pure heartbeat when the log has nothing newer)."""
+    nxt = w.next_idx[leader]  # [N]
+    prev_idx = nxt - 1
+    prev_term = w.log_term[leader, prev_idx]  # [N] gather
+    has_entry = nxt <= w.log_len[leader]
+    safe_nxt = jnp.minimum(nxt, cfg.log_cap - 1)
+    ent_term = jnp.where(has_entry, w.log_term[leader, safe_nxt], 0)
+    return _pays(
+        cfg, M_APPEND, leader, term, prev_idx, prev_term, ent_term, w.commit[leader]
     )
 
 
@@ -199,39 +269,44 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
         votes=w.votes.at[node].set(jnp.where(starting, self_bit, w.votes[node])),
         last_hb=w.last_hb.at[node].set(jnp.where(starting, now, w.last_hb[node])),
     )
-    bcast = _broadcast(cfg, w2, now, node, M_REQ_VOTE, new_term, rand, starting)
+    last_idx = w.log_len[node]
+    last_term = w.log_term[node, last_idx]
+    bcast, sent, delivered = _broadcast(
+        cfg, w2, now, node, rand, starting,
+        _pays(cfg, M_REQ_VOTE, node, new_term, last_idx, last_term),
+    )
     timeout = bounded(rand[2 * cfg.num_nodes], cfg.election_lo_ns, cfg.election_hi_ns)
     emits = _emits(
         cfg,
-        bcast[:4],
+        bcast,
         # one live election timer per node, always re-armed while valid
         (now + timeout, K_ELECTION, _pay(node, w.tgen[node]), valid),
         _DISABLED_EXTRA,
     )
-    w2 = w2._replace(
-        msgs_sent=w2.msgs_sent + bcast[4], msgs_delivered=w2.msgs_delivered + bcast[5]
-    )
+    w2 = w2._replace(msgs_sent=w2.msgs_sent + sent, msgs_delivered=w2.msgs_delivered + delivered)
     return w2, emits
 
 
 def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node, epoch = pay[0], pay[1]
     valid = w.alive[node] & (w.role[node] == LEADER) & (epoch == w.lepoch[node])
-    bcast = _broadcast(cfg, w, now, node, M_APPEND, w.term[node], rand, valid)
+    term = w.term[node]
+    bcast, sent, delivered = _broadcast(
+        cfg, w, now, node, rand, valid, _append_pays(cfg, w, node, term)
+    )
     emits = _emits(
         cfg,
-        bcast[:4],
+        bcast,
         (now + cfg.heartbeat_ns, K_HEARTBEAT, _pay(node, epoch), valid),
         _DISABLED_EXTRA,
     )
-    w2 = w._replace(
-        msgs_sent=w.msgs_sent + bcast[4], msgs_delivered=w.msgs_delivered + bcast[5]
-    )
+    w2 = w._replace(msgs_sent=w.msgs_sent + sent, msgs_delivered=w.msgs_delivered + delivered)
     return w2, emits
 
 
 def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     dst, mtype, src, mterm = pay[0], pay[1], pay[2], pay[3]
+    a, b, c, d = pay[4], pay[5], pay[6], pay[7]
     live = w.alive[dst]
     was_leader = live & (w.role[dst] == LEADER)
 
@@ -244,12 +319,22 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     is_rv = live & (mtype == M_REQ_VOTE)
     is_vg = live & (mtype == M_VOTE_GRANT)
     is_ap = live & (mtype == M_APPEND)
+    is_ar = live & (mtype == M_APPEND_RSP)
 
-    # RequestVote: grant iff same term and not voted for anyone else
-    grant = is_rv & (mterm == term_d) & ((voted_d == -1) | (voted_d == src))
+    # -- RequestVote (§5.4.1 up-to-date restriction): grant iff same term,
+    # not voted for anyone else, and candidate log >= ours
+    my_last_idx = w.log_len[dst]
+    my_last_term = w.log_term[dst, my_last_idx]
+    log_ok = (b > my_last_term) | ((b == my_last_term) & (a >= my_last_idx))
+    grant = (
+        is_rv
+        & (mterm == term_d)
+        & ((voted_d == -1) | (voted_d == src))
+        & log_ok
+    )
     voted_d = jnp.where(grant, src, voted_d)
 
-    # VoteGrant: count iff still candidate in that term
+    # -- VoteGrant: count iff still candidate in that term
     counted = is_vg & (role_d == CANDIDATE) & (mterm == term_d)
     src_bit = jnp.left_shift(jnp.uint32(1), src.astype(jnp.uint32))
     votes_d = jnp.where(counted, w.votes[dst] | src_bit, w.votes[dst])
@@ -257,71 +342,142 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     won = counted & (jax.lax.population_count(votes_d).astype(jnp.int32) >= majority)
     role_d = jnp.where(won, LEADER, role_d)
 
-    # AppendEntries (heartbeat): same-term leader signal resets the
-    # election timer basis and demotes a same-term candidate
+    # -- AppendEntries: same-term leader signal; consistency-check and
+    # append the carried entry; follow the leader's commit
     heard = is_ap & (mterm == term_d)
     role_d = jnp.where(heard & (role_d == CANDIDATE), FOLLOWER, role_d)
-    reset_hb = heard | grant | won
-
-    # a leader demoted by a higher term must re-enter the election-timer
-    # chain (its own timer chain ended when it fired during leadership);
-    # bump tgen so any stale timer stays dead, then arm a fresh one below
-    demoted = was_leader & (role_d != LEADER)
-    tgen_d = jnp.where(demoted, w.tgen[dst] + 1, w.tgen[dst])
+    prev_idx, prev_term, ent_term, leader_commit = a, b, c, d
+    consistent = heard & (prev_idx <= w.log_len[dst]) & (
+        w.log_term[dst, prev_idx] == prev_term
+    )
+    has_entry = ent_term > 0
+    slot_idx = prev_idx + 1
+    can_store = slot_idx < cfg.log_cap
+    store = consistent & has_entry & can_store
+    overflow = consistent & has_entry & ~can_store
+    # Raft §5.3 append rule: if the slot already holds this entry (same
+    # term) keep the existing suffix; a conflicting entry truncates the
+    # log at the new entry's index
+    existing_same = (slot_idx <= w.log_len[dst]) & (
+        w.log_term[dst, slot_idx] == ent_term
+    )
+    new_len = jnp.where(
+        store,
+        jnp.where(existing_same, w.log_len[dst], slot_idx),
+        w.log_len[dst],
+    )
 
     w2 = w._replace(
         term=w.term.at[dst].set(term_d),
         role=w.role.at[dst].set(role_d),
         voted=w.voted.at[dst].set(voted_d),
         votes=w.votes.at[dst].set(votes_d),
-        tgen=w.tgen.at[dst].set(tgen_d),
         lepoch=w.lepoch.at[dst].set(jnp.where(won, w.lepoch[dst] + 1, w.lepoch[dst])),
-        last_hb=w.last_hb.at[dst].set(jnp.where(reset_hb, now, w.last_hb[dst])),
+        last_hb=w.last_hb.at[dst].set(
+            jnp.where(heard | grant | won, now, w.last_hb[dst])
+        ),
+        log_term=w.log_term.at[dst, slot_idx].set(
+            jnp.where(store, ent_term, w.log_term[dst, slot_idx])
+        ),
+        log_len=w.log_len.at[dst].set(new_len),
+        log_overflow=w.log_overflow | overflow,
     )
     w2 = _record_election(cfg, w2, term_d, dst, won)
+    # follower commit: min(leader_commit, own len) once consistent
+    w2 = _advance_commit(
+        cfg, w2, dst, jnp.minimum(leader_commit, w2.log_len[dst]), consistent
+    )
 
-    # on win: broadcast immediate heartbeats + arm the heartbeat timer
-    bcast = _broadcast(cfg, w2, now, dst, M_APPEND, term_d, rand, won)
-    # extra slot: either the heartbeat timer (won) or the vote reply (grant)
-    # — mutually exclusive by message type
+    # -- AppendEntries response (leader side): update next/match, advance
+    # commit under the §5.4.2 current-term rule
+    rsp_ok = is_ar & (mterm == term_d) & (role_d == LEADER)
+    success = a == 1
+    new_match = jnp.where(rsp_ok & success, jnp.maximum(w2.match_idx[dst, src], b),
+                          w2.match_idx[dst, src])
+    new_next = jnp.where(
+        rsp_ok,
+        jnp.where(success, new_match + 1, jnp.maximum(w2.next_idx[dst, src] - 1, 1)),
+        w2.next_idx[dst, src],
+    )
+    w2 = w2._replace(
+        match_idx=w2.match_idx.at[dst, src].set(new_match),
+        next_idx=w2.next_idx.at[dst, src].set(new_next),
+    )
+    # commit: highest idx replicated on a majority with an entry of the
+    # leader's current term
+    idxs = jnp.arange(cfg.log_cap, dtype=jnp.int32)
+    self_mask = jnp.arange(cfg.num_nodes, dtype=jnp.int32) == dst
+    # replicas[i] = 1 + #followers with match_idx >= i
+    reps = 1 + jnp.sum(
+        (w2.match_idx[dst][None, :] >= idxs[:, None]) & ~self_mask[None, :],
+        axis=1, dtype=jnp.int32,
+    )
+    committable = (
+        (idxs <= w2.log_len[dst])
+        & (idxs > w2.commit[dst])
+        & (reps >= majority)
+        & (w2.log_term[dst] == term_d)
+    )
+    best = jnp.max(jnp.where(committable, idxs, 0))
+    w2 = _advance_commit(cfg, w2, dst, best, rsp_ok & (best > 0))
+
+    # a leader demoted by a higher term must re-enter the election-timer
+    # chain (its own timer chain ended when it fired during leadership)
+    demoted = was_leader & (role_d != LEADER)
+    tgen_d = jnp.where(demoted, w.tgen[dst] + 1, w.tgen[dst])
+    w2 = w2._replace(tgen=w2.tgen.at[dst].set(tgen_d))
+
+    # on win: reset leader bookkeeping and broadcast immediate heartbeats
+    init_next = w2.log_len[dst] + 1
+    w2 = w2._replace(
+        next_idx=jnp.where(won, w2.next_idx.at[dst, :].set(init_next), w2.next_idx),
+        match_idx=jnp.where(won, w2.match_idx.at[dst, :].set(0), w2.match_idx),
+    )
+    bcast, sent, delivered = _broadcast(
+        cfg, w2, now, dst, rand, won, _append_pays(cfg, w2, dst, term_d)
+    )
+    # extra slot 1: heartbeat timer (won) | vote reply (grant) | append rsp
     rt, rdeliver = enet.route(
         w.links, now, dst, src, rand[2 * cfg.num_nodes], rand[2 * cfg.num_nodes + 1]
     )
+    ap_success = jnp.where(consistent, 1, 0)
+    ap_match = jnp.where(store, slot_idx, jnp.minimum(prev_idx, w2.log_len[dst]))
+    reply_pay = jnp.where(
+        grant,
+        _pay(src, M_VOTE_GRANT, dst, mterm),
+        _pay(src, M_APPEND_RSP, dst, term_d, ap_success, ap_match),
+    )
+    send_reply = (grant | is_ap) & live & rdeliver
     extra_time = jnp.where(won, now + cfg.heartbeat_ns, rt)
     extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
-    extra_pay = jnp.where(
-        won,
-        _pay(dst, w2.lepoch[dst]),
-        _pay(src, M_VOTE_GRANT, dst, mterm),
-    )
-    extra_on = won | (grant & rdeliver)
-    # second extra: the demoted ex-leader's fresh election timer
+    extra_pay = jnp.where(won, _pay(dst, w2.lepoch[dst]), reply_pay)
+    extra_on = won | (send_reply & ~won)
+    # extra slot 2: the demoted ex-leader's fresh election timer
     retimeout = bounded(
         rand[2 * cfg.num_nodes + 2], cfg.election_lo_ns, cfg.election_hi_ns
     )
     emits = _emits(
         cfg,
-        bcast[:4],
+        bcast,
         (extra_time, extra_kind, extra_pay, extra_on),
         (now + retimeout, K_ELECTION, _pay(dst, tgen_d), demoted),
     )
     w2 = w2._replace(
-        msgs_sent=w2.msgs_sent + bcast[4] + jnp.where(grant, 1, 0),
-        msgs_delivered=w2.msgs_delivered
-        + bcast[5]
-        + jnp.where(grant & rdeliver, 1, 0),
+        msgs_sent=w2.msgs_sent + sent + jnp.where(send_reply, 1, 0),
+        msgs_delivered=w2.msgs_delivered + delivered + jnp.where(send_reply, 1, 0),
     )
     return w2, emits
 
 
 def _on_crash(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node = pay[0]
-    # durable state (term, voted) survives; volatile state resets
+    # durable state (term, voted, log) survives; volatile state resets
     # (ref kill semantics: task/mod.rs:347-364 — tasks dropped, state wiped)
     w2 = w._replace(
         alive=w.alive.at[node].set(False),
         role=w.role.at[node].set(FOLLOWER),
         votes=w.votes.at[node].set(jnp.uint32(0)),
+        commit=w.commit.at[node].set(0),
         tgen=w.tgen.at[node].set(w.tgen[node] + 1),
         lepoch=w.lepoch.at[node].set(w.lepoch[node] + 1),
     )
@@ -346,6 +502,35 @@ def _on_restart(cfg: RaftConfig, w: RaftState, now, pay, rand):
     return w2, emits
 
 
+def _on_cmd(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    """A client command looking for the leader: if the target node is a
+    live leader with log room, append an entry of its term; otherwise
+    retry against the next node after cmd_retry_ns."""
+    target, retries = pay[0], pay[1]
+    is_leader = w.alive[target] & (w.role[target] == LEADER)
+    slot = w.log_len[target] + 1
+    room = slot < cfg.log_cap
+    accept = is_leader & room
+    w2 = w._replace(
+        log_term=w.log_term.at[target, slot].set(
+            jnp.where(accept, w.term[target], w.log_term[target, slot])
+        ),
+        log_len=w.log_len.at[target].set(
+            jnp.where(accept, slot, w.log_len[target])
+        ),
+        log_overflow=w.log_overflow | (is_leader & ~room),
+        accepted_cmds=w.accepted_cmds + jnp.where(accept, 1, 0),
+    )
+    next_target = (target + 1) % cfg.num_nodes
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (now + cfg.cmd_retry_ns, K_CMD, _pay(next_target, retries + 1), ~accept),
+        _DISABLED_EXTRA,
+    )
+    return w2, emits
+
+
 def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
     branches = [
         partial(_on_election_timer, cfg),
@@ -353,17 +538,20 @@ def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
         partial(_on_msg, cfg),
         partial(_on_crash, cfg),
         partial(_on_restart, cfg),
+        partial(_on_cmd, cfg),
     ]
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
 def _init(cfg: RaftConfig, key):
     n = cfg.num_nodes
-    ninit = n + 2 * cfg.crashes
+    ninit = n + 2 * cfg.crashes + cfg.commands
     # init draws live in their own counter namespace, disjoint from the
     # per-event stream (event counters stay far below 2**31)
     rand = jax.random.bits(
-        jax.random.fold_in(key, 0x7FFF_FFFF), (ninit + cfg.crashes,), dtype=jnp.uint32
+        jax.random.fold_in(key, 0x7FFF_FFFF),
+        (ninit + cfg.crashes + cfg.commands,),
+        dtype=jnp.uint32,
     )
     w = RaftState(
         role=jnp.zeros((n,), jnp.int32),
@@ -374,13 +562,23 @@ def _init(cfg: RaftConfig, key):
         last_hb=jnp.zeros((n,), jnp.int64),
         tgen=jnp.zeros((n,), jnp.int32),
         lepoch=jnp.zeros((n,), jnp.int32),
+        log_term=jnp.zeros((n, cfg.log_cap), jnp.int32),
+        log_len=jnp.zeros((n,), jnp.int32),
+        commit=jnp.zeros((n,), jnp.int32),
+        next_idx=jnp.ones((n, n), jnp.int32),
+        match_idx=jnp.zeros((n, n), jnp.int32),
         links=enet.make(n, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns),
         hist_term=jnp.zeros((cfg.history,), jnp.int32),
         hist_node=jnp.zeros((cfg.history,), jnp.int32),
         hist_valid=jnp.zeros((cfg.history,), bool),
         hist_pos=jnp.zeros((), jnp.int32),
+        chist_term=jnp.zeros((cfg.log_cap,), jnp.int32),
+        chist_set=jnp.zeros((cfg.log_cap,), bool),
         violation=jnp.zeros((), bool),
+        log_overflow=jnp.zeros((), bool),
         elections=jnp.zeros((), jnp.int32),
+        commits=jnp.zeros((), jnp.int32),
+        accepted_cmds=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
     )
@@ -404,6 +602,14 @@ def _init(cfg: RaftConfig, key):
         times = times.at[n + 2 * c + 1].set(t_crash + delay)
         kinds = kinds.at[n + 2 * c + 1].set(K_RESTART)
         pays = pays.at[n + 2 * c + 1].set(_pay(victim))
+    # client command plan
+    base = n + 2 * cfg.crashes
+    for k in range(cfg.commands):
+        t_cmd = bounded(rand[base + k], 0, cfg.cmd_window_ns)
+        target = bounded(rand[ninit + cfg.crashes + k], 0, n).astype(jnp.int32)
+        times = times.at[base + k].set(t_cmd)
+        kinds = kinds.at[base + k].set(K_CMD)
+        pays = pays.at[base + k].set(_pay(target, 0))
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
@@ -420,9 +626,9 @@ def workload(cfg: RaftConfig = RaftConfig()) -> Workload:
 
 def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
     """Engine parameters sized for this workload (queue holds worst-case
-    in-flight: N broadcasts from every node + timers + fault plan)."""
+    in-flight: N broadcasts from every node + timers + fault/cmd plans)."""
     defaults = dict(
-        queue_capacity=max(64, 4 * cfg.num_nodes * cfg.num_nodes),
+        queue_capacity=max(64, 4 * cfg.num_nodes * cfg.num_nodes + cfg.commands),
         time_limit_ns=10_000_000_000,
         max_steps=200_000,
     )
@@ -440,6 +646,9 @@ def sweep_summary(final) -> dict:
         "violations": int(np.sum(np.asarray(w.violation))),
         "elections_total": int(np.sum(np.asarray(w.elections))),
         "no_leader_seeds": int(np.sum(np.asarray(w.elections) == 0)),
+        "commits_total": int(np.sum(np.asarray(w.commits))),
+        "accepted_cmds": int(np.sum(np.asarray(w.accepted_cmds))),
+        "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
         "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
         "events_total": int(np.sum(np.asarray(final.ctr))),
         "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),

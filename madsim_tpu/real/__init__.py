@@ -1,0 +1,35 @@
+"""Real-mode twin — the analogue of the reference's ``std`` tree.
+
+The reference compiles every API to the real library when ``--cfg madsim``
+is absent: tokio re-exports, a tag-matching Endpoint over real TCP with
+length-delimited frames, and real RPC on top (madsim/src/std/, SURVEY.md
+§2.1 "std twin"). This package is the same idea for Python: the simulation
+API surface backed by asyncio and real sockets, so workload code written
+against madsim_tpu runs unmodified against a real network:
+
+    from madsim_tpu import real as ms       # instead of `import madsim_tpu as ms`
+    rt = ms.Runtime()
+    rt.block_on(main())
+
+Provided: ``Runtime.block_on``, ``spawn``, ``sleep``/``timeout``/
+``interval``/``Instant``, tag-matching ``Endpoint`` over real UDP
+datagrams, and the built-in RPC (``call`` / ``add_rpc_handler``) speaking
+pickled frames. Randomness is real randomness; there is no determinism in
+real mode (matching the reference, where buggify is a no-op and seeds
+don't exist, std/buggify.rs:6-30).
+"""
+
+from .runtime import Runtime, spawn
+from .time import Instant, interval, now_instant, sleep, timeout
+from .net import Endpoint
+
+__all__ = [
+    "Endpoint",
+    "Instant",
+    "Runtime",
+    "interval",
+    "now_instant",
+    "sleep",
+    "spawn",
+    "timeout",
+]

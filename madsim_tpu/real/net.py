@@ -1,0 +1,150 @@
+"""Real-mode networking: the tag-matching Endpoint over real UDP.
+
+The reference's std Endpoint speaks length-delimited frames over real TCP
+with a tag→mailbox dispatcher and RPC on top (madsim/src/std/net/tcp.rs:
+42-327, std/net/rpc.rs). Here each Endpoint is an asyncio UDP socket;
+frames are pickled ``(tag, payload)`` tuples (datagram framing comes for
+free), the mailbox matches tags exactly like the sim side, and the
+built-in RPC reuses the sim's Request/hash conventions so the same
+service classes work in both modes.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..net.rpc import request_id
+from . import time as rtime
+from .runtime import spawn
+
+Addr = Tuple[str, int]
+
+
+def _parse(addr: "str | Addr") -> Addr:
+    if isinstance(addr, tuple):
+        return (addr[0], int(addr[1]))
+    host, _, port = addr.rpartition(":")
+    return (host or "127.0.0.1", int(port))
+
+
+class _Mailbox:
+    def __init__(self) -> None:
+        self.msgs: Dict[int, List[Tuple[Any, Addr]]] = {}
+        self.waiters: Dict[int, List[asyncio.Future]] = {}
+
+    def deliver(self, tag: int, payload: Any, src: Addr) -> None:
+        waiters = self.waiters.get(tag)
+        while waiters:
+            fut = waiters.pop(0)
+            if not fut.done():
+                fut.set_result((payload, src))
+                return
+        self.msgs.setdefault(tag, []).append((payload, src))
+
+    async def recv(self, tag: int) -> Tuple[Any, Addr]:
+        pending = self.msgs.get(tag)
+        if pending:
+            return pending.pop(0)
+        fut: asyncio.Future = asyncio.get_running_loop().create_future()
+        self.waiters.setdefault(tag, []).append(fut)
+        return await fut
+
+
+class _Proto(asyncio.DatagramProtocol):
+    def __init__(self, mailbox: _Mailbox):
+        self.mailbox = mailbox
+
+    def datagram_received(self, data: bytes, addr: Addr) -> None:
+        try:
+            tag, payload = pickle.loads(data)
+        except Exception:
+            return  # malformed frame — drop, like a bad packet
+        self.mailbox.deliver(tag, payload, addr)
+
+
+class Endpoint:
+    """Tag-matching datagram endpoint over a real UDP socket."""
+
+    def __init__(self, transport: asyncio.DatagramTransport, mailbox: _Mailbox):
+        self._transport = transport
+        self._mailbox = mailbox
+        self._peer: Optional[Addr] = None
+
+    @staticmethod
+    async def bind(addr: "str | Addr") -> "Endpoint":
+        loop = asyncio.get_running_loop()
+        mailbox = _Mailbox()
+        transport, _ = await loop.create_datagram_endpoint(
+            lambda: _Proto(mailbox), local_addr=_parse(addr)
+        )
+        return Endpoint(transport, mailbox)
+
+    @staticmethod
+    async def connect(addr: "str | Addr") -> "Endpoint":
+        ep = await Endpoint.bind(("127.0.0.1", 0))
+        ep._peer = _parse(addr)
+        return ep
+
+    def local_addr(self) -> Addr:
+        return self._transport.get_extra_info("sockname")[:2]
+
+    def peer_addr(self) -> Addr:
+        if self._peer is None:
+            raise OSError("endpoint is not connected")
+        return self._peer
+
+    def close(self) -> None:
+        self._transport.close()
+
+    # -- tag-matching datagram API ----------------------------------------
+
+    async def send_to_raw(self, dst: "str | Addr", tag: int, payload: Any) -> None:
+        self._transport.sendto(pickle.dumps((tag, payload)), _parse(dst))
+
+    async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:
+        return await self._mailbox.recv(tag)
+
+    async def send_to(self, dst: "str | Addr", tag: int, data: bytes) -> None:
+        await self.send_to_raw(dst, tag, bytes(data))
+
+    async def recv_from(self, tag: int) -> Tuple[bytes, Addr]:
+        return await self.recv_from_raw(tag)
+
+    async def send(self, tag: int, data: bytes) -> None:
+        await self.send_to(self.peer_addr(), tag, data)
+
+    async def recv(self, tag: int) -> bytes:
+        data, _ = await self.recv_from(tag)
+        return data
+
+    # -- built-in RPC (same wire convention as the sim side) ---------------
+
+    async def call(self, dst: "str | Addr", req: Any) -> Any:
+        import random as _random
+
+        rsp_tag = _random.getrandbits(64)
+        await self.send_to_raw(dst, request_id(req), (rsp_tag, req, b""))
+        payload, _src = await self.recv_from_raw(rsp_tag)
+        rsp, _data = payload
+        return rsp
+
+    async def call_timeout(self, dst: "str | Addr", req: Any, timeout_s: float) -> Any:
+        return await rtime.timeout(timeout_s, self.call(dst, req))
+
+    def add_rpc_handler(self, req_type: type, handler: Any) -> None:
+        rid = request_id(req_type)
+
+        async def accept_loop() -> None:
+            while True:
+                payload, src = await self.recv_from_raw(rid)
+                rsp_tag, req, _data = payload
+
+                async def handle_one(req=req, rsp_tag=rsp_tag, src=src) -> None:
+                    rsp = await handler(req)
+                    await self.send_to_raw(src, rsp_tag, (rsp, b""))
+
+                spawn(handle_one())
+
+        spawn(accept_loop())

@@ -148,3 +148,26 @@ def test_seed_is_exposed():
         return ms.current_handle().seed
 
     assert rt.block_on(main()) == 31337
+
+
+def test_cpu_count_reports_node_cores():
+    """os.cpu_count inside a sim task = the node's configured cores
+    (ref sched_getaffinity/sysconf interposition, task/mod.rs:707-760)."""
+    import os
+
+    import madsim_tpu as ms
+
+    rt = ms.Runtime(seed=5)
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("big").cores(16).build()
+
+        async def probe():
+            return os.cpu_count()
+
+        assert await node.spawn(probe()) == 16
+        assert os.cpu_count() == 1  # main node default
+
+    rt.block_on(main())
+    assert isinstance(os.cpu_count(), int)  # restored outside the sim

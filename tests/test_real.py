@@ -1,0 +1,98 @@
+"""Real-mode twin: the sim API surface over asyncio + real sockets
+(the analogue of the reference's std tree, madsim/src/std/)."""
+
+import time as walltime
+
+import pytest
+
+from madsim_tpu import real
+from madsim_tpu.net.rpc import Request
+
+
+class Ping(Request):
+    def __init__(self, value: int):
+        self.value = value
+
+
+def test_real_endpoint_tag_matching_loopback():
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.Endpoint.bind(("127.0.0.1", 0))
+        client = await real.Endpoint.bind(("127.0.0.1", 0))
+        addr = server.local_addr()
+
+        async def serve():
+            data, src = await server.recv_from(7)
+            assert data == b"ping"
+            await server.send_to(src, 8, b"pong")
+
+        t = real.spawn(serve())
+        await client.send_to(addr, 7, b"ping")
+        data, _src = await client.recv_from(8)
+        assert data == b"pong"
+        await t
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_real_rpc_roundtrip():
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.Endpoint.bind(("127.0.0.1", 0))
+
+        async def handler(req: Ping) -> int:
+            return req.value * 2
+
+        server.add_rpc_handler(Ping, handler)
+        client = await real.Endpoint.bind(("127.0.0.1", 0))
+        for i in range(5):
+            assert await client.call(server.local_addr(), Ping(i)) == 2 * i
+        # call_timeout against a dead port times out
+        with pytest.raises(real.time.TimeoutError):
+            await client.call_timeout(("127.0.0.1", 1), Ping(1), 0.2)
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_real_time_is_wall_time():
+    rt = real.Runtime()
+
+    async def main():
+        t0 = walltime.monotonic()
+        await real.sleep(0.05)
+        assert walltime.monotonic() - t0 >= 0.045
+        iv = real.interval(0.02)
+        await iv.tick()  # immediate
+        t1 = walltime.monotonic()
+        await iv.tick()
+        assert walltime.monotonic() - t1 >= 0.01
+
+    rt.block_on(main())
+
+
+def test_real_spawn_and_abort():
+    rt = real.Runtime()
+
+    async def main():
+        hits = []
+
+        async def worker():
+            while True:
+                await real.sleep(0.01)
+                hits.append(1)
+
+        h = real.spawn(worker())
+        await real.sleep(0.05)
+        h.abort()
+        await real.sleep(0.03)
+        n = len(hits)
+        await real.sleep(0.03)
+        assert len(hits) == n and n >= 2
+
+    rt.block_on(main())
