@@ -1,0 +1,53 @@
+"""Forced-CPU-mesh environment recipe (jax-free, import-safe anywhere).
+
+A JAX process can emulate an n-device mesh on one host by setting
+``JAX_PLATFORMS=cpu`` and ``--xla_force_host_platform_device_count=n``
+*before* JAX initializes. Two call sites need the exact same recipe —
+``tests/conftest.py`` (pytest env) and ``__graft_entry__.dryrun_multichip``
+(the driver's multi-chip gate subprocess) — so it lives here once.
+
+TPU-plugin sitecustomizes (gated on ``PALLAS_AXON_POOL_IPS``) re-register
+the device backend and override ``jax_platforms`` after init; the gate env
+var is dropped so the target interpreter stays CPU-only.
+"""
+
+from __future__ import annotations
+
+import re
+import sys
+from typing import MutableMapping
+
+_FLAG = "--xla_force_host_platform_device_count"
+_FLAG_RE = re.compile(re.escape(_FLAG) + r"=(\d+)")
+
+
+def force_cpu_mesh_env(env: MutableMapping[str, str], n_devices: int) -> None:
+    """Mutate ``env`` so a fresh interpreter sees >= n_devices CPU devices.
+
+    An existing device-count flag is raised to ``n_devices`` (never
+    lowered — a larger pre-set mesh still satisfies the caller).
+    """
+    env["JAX_PLATFORMS"] = "cpu"
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    flags = env.get("XLA_FLAGS", "")
+    m = _FLAG_RE.search(flags)
+    if m:
+        count = max(int(m.group(1)), n_devices)
+        flags = _FLAG_RE.sub(f"{_FLAG}={count}", flags)
+    else:
+        flags = (flags + f" {_FLAG}={n_devices}").strip()
+    env["XLA_FLAGS"] = flags
+
+
+def apply_in_process() -> None:
+    """Force the cpu platform even if jax was already imported.
+
+    Sitecustomize hooks can import (and platform-pin) jax at interpreter
+    startup, before any user code runs; env vars alone are then too late.
+    ``jax.config.update`` still wins as long as no backend has been
+    initialized, which is the case at conftest-import time.
+    """
+    if "jax" in sys.modules:
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")

@@ -1,15 +1,24 @@
 """Test environment: force JAX onto CPU with 8 virtual devices so sharding
 tests run without TPU hardware (the driver separately dry-runs multichip).
 
-Must run before any ``import jax`` in test modules — pytest imports conftest
-first, so setting the env here is sufficient.
+The helper is loaded by file path — NOT via ``import madsim_tpu`` — so no
+package ``__init__`` code (which could some day import jax) runs before the
+environment is forced. ``apply_in_process`` additionally covers machines
+whose sitecustomize imports jax at interpreter startup, before conftest.
 """
 
+import importlib.util
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (
-        flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+
+_spec = importlib.util.spec_from_file_location(
+    "_cpu_mesh_env", os.path.join(_repo, "madsim_tpu", "_cpu_mesh_env.py")
+)
+_mod = importlib.util.module_from_spec(_spec)
+_spec.loader.exec_module(_mod)
+
+_mod.force_cpu_mesh_env(os.environ, 8)
+_mod.apply_in_process()
