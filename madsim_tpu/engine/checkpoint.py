@@ -11,15 +11,13 @@ preemption and failed seeds to be re-examined from mid-run state.
 
 from __future__ import annotations
 
-from typing import Any
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from .core import EngineConfig, EngineState, Workload, step_batch
+from .core import EngineConfig, EngineState, Workload
 
-_FORMAT_VERSION = 1
+_FORMAT_VERSION = 2  # v2: EngineState gained qmax; draw layout adds tie-break
 
 
 def save_sweep(state: EngineState, path: str) -> None:
@@ -56,17 +54,6 @@ def resume_sweep(
     """Continue a (possibly restored) sweep until every seed finishes."""
     from functools import partial
 
-    @partial(jax.jit, static_argnums=(0, 1))
-    def run(workload: Workload, cfg: EngineConfig, state: EngineState):
-        def cond(carry: Any):
-            s, iters = carry
-            return jnp.any(~s.done) & (iters < cfg.max_steps)
+    from .core import drive
 
-        def body(carry: Any):
-            s, iters = carry
-            return step_batch(workload, cfg, s), iters + 1
-
-        s, _ = jax.lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
-        return s
-
-    return run(workload, cfg, state)
+    return partial(jax.jit, static_argnums=(0, 1))(drive)(workload, cfg, state)

@@ -83,6 +83,12 @@ class EngineConfig(NamedTuple):
     max_steps: int = 100_000
     jitter_lo_ns: int = 50
     jitter_hi_ns: int = 100
+    # steps per termination check: the sweep's while-loop cond is only
+    # evaluated every `cond_interval` steps (stepping a finished seed is a
+    # frozen no-op, so over-stepping is harmless — at most interval-1
+    # padded steps at the end). Amortizes per-cond overhead on backends
+    # that charge for it without meaningful tail waste.
+    cond_interval: int = 16
 
 
 class EngineState(NamedTuple):
@@ -95,11 +101,18 @@ class EngineState(NamedTuple):
     ctr: jnp.ndarray  # int32 events processed (RNG counter)
     done: jnp.ndarray  # bool
     overflow: jnp.ndarray  # bool sticky queue-overflow flag
+    qmax: jnp.ndarray  # int32 queue-occupancy high-water mark
     queue: EventQueue
     wstate: Any  # workload pytree
 
 
 def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> EngineState:
+    if workload.max_emits > cfg.queue_capacity:
+        raise ValueError(
+            f"workload.max_emits ({workload.max_emits}) exceeds "
+            f"queue_capacity ({cfg.queue_capacity}); every handler "
+            "invocation must be able to enqueue its full emit batch"
+        )
     key = seed_key(seed)
     wstate, emits = workload.init(key)
     q = equeue.make(cfg.queue_capacity, workload.payload_slots)
@@ -111,6 +124,7 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
         ctr=jnp.zeros((), jnp.int32),
         done=jnp.zeros((), bool),
         overflow=overflow,
+        qmax=equeue.size(q),
         queue=q,
         wstate=wstate,
     )
@@ -121,25 +135,36 @@ def init_sweep(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> Eng
     return jax.vmap(partial(_init_one, workload, cfg))(jnp.asarray(seeds, jnp.int64))
 
 
+def _pop_event(workload: Workload, s: EngineState, enable):
+    """Draw this event's randomness and pop the next event.
+
+    Draw layout: ``rand[0]`` clock jitter, ``rand[1]`` pop tie-break,
+    ``rand[2:]`` workload handler draws. Shared by the sweep step and the
+    traced replay so both consume identical streams.
+    """
+    rand = event_bits(s.key, s.ctr, workload.num_rand + 2)
+    q, t, kind, pay, found = equeue.pop_min(s.queue, enable=enable, tie_u32=rand[1])
+    return rand, q, t, kind, pay, found
+
+
 def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineState:
     """Advance one seed by one event (no-op once ``done``).
 
     Three masks compose: already-done seeds freeze entirely; a
     popped-empty queue or expired clock marks done without dispatching;
     only ``take`` applies the handler's writes. Queue mutations are gated
-    at the scatter level (pop ``enable`` / push ``enables``) so the big
+    at the mask level (pop ``enable`` / push ``enables``) so the big
     [Q]-sized arrays never need a whole-array select; only the workload
     state goes through a select tree."""
     active = ~s.done
-    q, t, kind, pay, found = equeue.pop_min(s.queue, enable=active)
-    rand = event_bits(s.key, s.ctr, workload.num_rand + 1)
+    rand, q, t, kind, pay, found = _pop_event(workload, s, active)
     jitter = bounded(rand[0], cfg.jitter_lo_ns, cfg.jitter_hi_ns + 1)
     now = jnp.maximum(s.now_ns, t) + jitter
     time_up = now > cfg.time_limit_ns
     dispatch = found & ~time_up
     take = active & dispatch
 
-    wstate, emits = workload.handle(s.wstate, now, kind, pay, rand[1:])
+    wstate, emits = workload.handle(s.wstate, now, kind, pay, rand[2:])
     q, ov = equeue.push_many(
         q, emits.times, emits.kinds, emits.pays, emits.enables & take
     )
@@ -154,6 +179,7 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         ctr=jnp.where(take, s.ctr + 1, s.ctr),
         done=s.done | (active & (~found | time_up)),
         overflow=s.overflow | (take & ov),
+        qmax=jnp.maximum(s.qmax, equeue.size(q)),
         queue=q,
         wstate=sel(take, wstate, s.wstate),
     )
@@ -164,9 +190,17 @@ def step_batch(workload: Workload, cfg: EngineConfig, state: EngineState) -> Eng
     return jax.vmap(partial(step_one, workload, cfg))(state)
 
 
-@partial(jax.jit, static_argnums=(0, 1))
-def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
-    state = init_sweep(workload, cfg, seeds)
+def drive(workload: Workload, cfg: EngineConfig, state: EngineState) -> EngineState:
+    """Step a batched state until every seed is done or ``max_steps`` is
+    hit — the single shared sweep driver (used by ``run_sweep``,
+    ``checkpoint.resume_sweep``; the sharded driver in parallel/mesh adds
+    a psum but follows the same shape).
+
+    The termination cond is only evaluated every ``cond_interval`` steps;
+    the final chunk is clamped so exactly ``max_steps`` live steps can
+    ever run — keeping the sweep bit-identical to ``run_traced``'s
+    ``length=max_steps`` scan for budget-cut seeds.
+    """
 
     def cond(carry):
         state, iters = carry
@@ -174,10 +208,19 @@ def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineSta
 
     def body(carry):
         state, iters = carry
-        return step_batch(workload, cfg, state), iters + 1
+        n = jnp.minimum(cfg.cond_interval, cfg.max_steps - iters)
+        state = jax.lax.fori_loop(
+            0, n, lambda _, s: step_batch(workload, cfg, s), state
+        )
+        return state, iters + n
 
     state, _ = jax.lax.while_loop(cond, body, (state, jnp.zeros((), jnp.int64)))
     return state
+
+
+@partial(jax.jit, static_argnums=(0, 1))
+def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
+    return drive(workload, cfg, init_sweep(workload, cfg, seeds))
 
 
 def run_sweep(workload: Workload, cfg: EngineConfig, seeds) -> EngineState:
@@ -192,7 +235,7 @@ def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray):
 
     def scan_step(s, _):
         before_ctr = s.ctr
-        q, t, kind, pay, found = equeue.pop_min(s.queue)
+        _, q, t, kind, pay, found = _pop_event(workload, s, jnp.zeros((), bool))
         s2 = step_one(workload, cfg, s)
         fired = s2.ctr > before_ctr
         rec = (

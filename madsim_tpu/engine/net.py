@@ -9,10 +9,19 @@ clogged-node/link sets and draws per-message loss + latency
     loss_q32: uint32      packet-loss probability, Q0.32 fixed point
     lat_lo/hi_ns          latency range, drawn uniformly per message
                           (reference default 1-10 ms, network.rs:87-89)
+    buggify_q32           probability of a buggified latency *spike*
+                          (reference: 10% → 1-5 s when buggify is on,
+                          madsim/src/sim/net/mod.rs:287-295); 0 = off
+    spike_lo/hi_ns        the spike latency range
 
-``route`` turns one (src, dst, two uint32 draws) into a delivery deadline +
-deliver flag — the whole decision is a handful of vector ops, evaluated for
-every in-flight message of every seed in lockstep.
+Lookups are one-hot masked (no dynamic gather — see engine/ops.py): a
+``route`` decision is a handful of dense vector ops, evaluated for every
+in-flight message of every seed in lockstep.
+
+The spike coin reuses the loss draw remixed by a multiplicative hash
+rather than consuming an extra stream slot: a dropped packet never needs a
+latency, so the two decisions are never observable together and the remix
+keeps the per-event draw budget flat while staying bit-reproducible.
 """
 
 from __future__ import annotations
@@ -21,6 +30,7 @@ from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
+from .ops import get1, get2
 from .rng import bounded, coin
 
 
@@ -29,6 +39,9 @@ class LinkState(NamedTuple):
     loss_q32: jnp.ndarray  # uint32 scalar
     lat_lo_ns: jnp.ndarray  # int64 scalar
     lat_hi_ns: jnp.ndarray  # int64 scalar
+    buggify_q32: jnp.ndarray  # uint32 scalar (0 = spikes off)
+    spike_lo_ns: jnp.ndarray  # int64 scalar
+    spike_hi_ns: jnp.ndarray  # int64 scalar
 
 
 def make(
@@ -36,13 +49,30 @@ def make(
     loss_q32: int = 0,
     lat_lo_ns: int = 1_000_000,
     lat_hi_ns: int = 10_000_000,
+    buggify_q32: int = 0,
+    spike_lo_ns: int = 1_000_000_000,
+    spike_hi_ns: int = 5_000_000_000,
 ) -> LinkState:
     return LinkState(
         clog=jnp.zeros((num_nodes, num_nodes), bool),
         loss_q32=jnp.asarray(loss_q32, jnp.uint32),
         lat_lo_ns=jnp.asarray(lat_lo_ns, jnp.int64),
         lat_hi_ns=jnp.asarray(lat_hi_ns, jnp.int64),
+        buggify_q32=jnp.asarray(buggify_q32, jnp.uint32),
+        spike_lo_ns=jnp.asarray(spike_lo_ns, jnp.int64),
+        spike_hi_ns=jnp.asarray(spike_hi_ns, jnp.int64),
     )
+
+
+def _latency(links: LinkState, u_loss, u_lat):
+    """Latency draw with buggified spikes (spike coin = remixed loss draw)."""
+    u_spike = jnp.asarray(u_loss, jnp.uint32) * jnp.uint32(2654435761) + jnp.uint32(
+        0x9E3779B9
+    )
+    spike = coin(u_spike, links.buggify_q32)
+    normal = bounded(u_lat, links.lat_lo_ns, links.lat_hi_ns + 1)
+    spiked = bounded(u_lat, links.spike_lo_ns, links.spike_hi_ns + 1)
+    return jnp.where(spike, spiked, normal)
 
 
 def route(
@@ -56,10 +86,9 @@ def route(
     """Per-message link test (ref ``test_link``): returns
     ``(deliver_time_ns, deliver)`` — dropped when the directed link is
     clogged or the loss draw fires."""
-    clogged = links.clog[src, dst]
+    clogged = get2(links.clog, src, dst)
     lost = coin(u_loss, links.loss_q32)
-    latency = bounded(u_lat, links.lat_lo_ns, links.lat_hi_ns + 1)
-    return now_ns + latency, ~(clogged | lost)
+    return now_ns + _latency(links, u_loss, u_lat), ~(clogged | lost)
 
 
 def route_from(
@@ -71,10 +100,9 @@ def route_from(
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Vectorized ``route`` for a broadcast: link-test src→every node at
     once. Returns ``(deliver_times[N], deliver[N])``."""
-    clogged = links.clog[src, :]
+    clogged = get1(links.clog, src)
     lost = coin(u_loss, links.loss_q32)
-    latency = bounded(u_lat, links.lat_lo_ns, links.lat_hi_ns + 1)
-    return now_ns + latency, ~(clogged | lost)
+    return now_ns + _latency(links, u_loss, u_lat), ~(clogged | lost)
 
 
 def clog_node(links: LinkState, node: jnp.ndarray) -> LinkState:
@@ -93,8 +121,14 @@ def unclog_node(links: LinkState, node: jnp.ndarray) -> LinkState:
 
 
 def clog_link(links: LinkState, src: jnp.ndarray, dst: jnp.ndarray) -> LinkState:
-    return links._replace(clog=links.clog.at[src, dst].set(True))
+    n = links.clog.shape[0]
+    idx = jnp.arange(n)
+    mask = (idx[:, None] == src) & (idx[None, :] == dst)
+    return links._replace(clog=links.clog | mask)
 
 
 def unclog_link(links: LinkState, src: jnp.ndarray, dst: jnp.ndarray) -> LinkState:
-    return links._replace(clog=links.clog.at[src, dst].set(False))
+    n = links.clog.shape[0]
+    idx = jnp.arange(n)
+    mask = (idx[:, None] == src) & (idx[None, :] == dst)
+    return links._replace(clog=links.clog & ~mask)

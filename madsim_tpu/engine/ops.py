@@ -1,0 +1,80 @@
+"""One-hot indexing primitives — TPU-friendly dynamic scatter/gather.
+
+Under ``vmap``, ``arr.at[idx].set(v)`` / ``arr[idx]`` with a traced index
+lower to batched scatter/gather ops, which the TPU executes ~6-10x slower
+than dense vector code (measured on v5e: 0.25-0.5 ms per op over a 16k
+batch vs 0.05 ms for the masked equivalent). For the small per-seed tables
+this engine manipulates (queues of ~100 slots, node arrays of ~5), the
+classic SPMD alternative is strictly better: build a one-hot mask over the
+indexed axis and reduce/select densely. Every op below compiles to pure
+elementwise + reduction HLO — no scatter, no gather — and fuses with its
+neighbours.
+
+All helpers preserve dtype bit-exactly (reductions pick exactly one
+element), so replay parity between backends is unaffected.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def onehot(idx, n: int):
+    """bool[n] mask with True at ``idx`` (clamped semantics: out-of-range
+    index selects nothing)."""
+    return jnp.arange(n, dtype=jnp.int32) == jnp.asarray(idx, jnp.int32)
+
+
+def _pick(arr, mask, axis):
+    """Reduce ``arr`` over ``axis`` picking the single masked element."""
+    if arr.dtype == jnp.bool_:
+        return jnp.any(arr & mask, axis=axis)
+    zero = jnp.zeros((), arr.dtype)
+    return jnp.sum(jnp.where(mask, arr, zero), axis=axis, dtype=arr.dtype)
+
+
+def _expand(mask, ndim: int):
+    """Broadcast a leading-axis mask to ``ndim`` dims."""
+    return mask.reshape(mask.shape + (1,) * (ndim - mask.ndim))
+
+
+def get1(arr, idx):
+    """``arr[idx]`` along axis 0 (scalar index; works for rows too)."""
+    mask = onehot(idx, arr.shape[0])
+    return _pick(arr, _expand(mask, arr.ndim), axis=0)
+
+
+def set1(arr, idx, val, enable=True):
+    """``arr[idx] = val`` when ``enable`` (axis 0; ``val`` may be a row)."""
+    mask = onehot(idx, arr.shape[0]) & jnp.asarray(enable, bool)
+    return jnp.where(_expand(mask, arr.ndim), jnp.asarray(val, arr.dtype), arr)
+
+
+def geti(arr, idxs):
+    """``arr[idxs]`` — gather a vector of scalar indices from a 1-D array."""
+    n = arr.shape[0]
+    mask = jnp.asarray(idxs, jnp.int32)[:, None] == jnp.arange(n, dtype=jnp.int32)[None, :]
+    return _pick(arr[None, :], mask, axis=1)
+
+
+def get2(arr, i, j):
+    """``arr[i, j]`` — scalar from a 2-D array."""
+    mask = onehot(i, arr.shape[0])[:, None] & onehot(j, arr.shape[1])[None, :]
+    return _pick(arr, mask, axis=(0, 1))
+
+
+def set2(arr, i, j, val, enable=True):
+    """``arr[i, j] = val`` when ``enable`` — 2-D point write."""
+    mask = (
+        onehot(i, arr.shape[0])[:, None]
+        & onehot(j, arr.shape[1])[None, :]
+        & jnp.asarray(enable, bool)
+    )
+    return jnp.where(mask, jnp.asarray(val, arr.dtype), arr)
+
+
+def getrow_i(arr, row, idxs):
+    """``arr[row, idxs]`` — gather a vector of columns from one (dynamic)
+    row of a 2-D array. Returns shape ``idxs.shape``."""
+    r = get1(arr, row)  # [C]
+    return geti(r, idxs)

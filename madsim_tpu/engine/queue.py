@@ -11,11 +11,16 @@ slot table per seed —
     pay   : int32[Q,P] payload slots
     valid : bool[Q]
 
-``pop_min`` = masked argmin over Q; ``push`` = write at first free slot.
-Both are O(Q) dense vector ops — for Q ≲ 256 that is a handful of VPU
-lanes, far cheaper than the host round-trip it replaces. Ties on time break
-by slot index (deterministic; schedule randomization comes from the jitter
-every inserted event carries, not from pop order).
+``pop_min`` = min + one-hot invalidate; ``push_many`` = rank-select masked
+writes. Everything is dense vector code — **no dynamic scatter or gather**,
+which on TPU run ~6-10x slower than the masked equivalents (see
+engine/ops.py). For Q ≲ 256 each op is a handful of VPU lanes, far cheaper
+than the host round-trip it replaces.
+
+Equal-time pops break ties *randomly* via a caller-supplied counter-RNG
+draw (``tie_u32``), mirroring the reference's uniformly-random ready-queue
+pop (madsim/src/sim/utils/mpsc.rs:71-84) — the stated source of schedule
+amplification — while staying bit-reproducible per (seed, event index).
 
 Overflow sets a sticky flag instead of corrupting state; the sweep driver
 surfaces it per seed so the run can be retried with a larger Q.
@@ -27,7 +32,11 @@ from typing import NamedTuple, Tuple
 
 import jax.numpy as jnp
 
+from .ops import onehot
+
 INVALID_TIME = jnp.iinfo(jnp.int64).max
+
+_HASH_MULT = 2654435761  # Knuth multiplicative hash constant
 
 
 class EventQueue(NamedTuple):
@@ -56,22 +65,19 @@ def push(
     """Insert one event at the first free slot (no-op when ``enable`` is
     False). Returns ``(queue', overflowed)``."""
     free = ~q.valid
-    slot = jnp.argmax(free)  # first free slot index
     have_room = jnp.any(free)
-    do = enable & have_room
+    do = jnp.asarray(enable, bool) & have_room
+    mask = onehot(jnp.argmax(free), q.valid.shape[0]) & do
     overflow = enable & ~have_room
     return (
         EventQueue(
-            time=q.time.at[slot].set(jnp.where(do, time, q.time[slot])),
-            kind=q.kind.at[slot].set(jnp.where(do, kind, q.kind[slot])),
-            pay=q.pay.at[slot].set(jnp.where(do, pay, q.pay[slot])),
-            valid=q.valid.at[slot].set(q.valid[slot] | do),
+            time=jnp.where(mask, jnp.asarray(time, jnp.int64), q.time),
+            kind=jnp.where(mask, jnp.asarray(kind, jnp.int32), q.kind),
+            pay=jnp.where(mask[:, None], pay, q.pay),
+            valid=q.valid | mask,
         ),
         overflow,
     )
-
-
-import jax
 
 
 def push_many(
@@ -81,59 +87,80 @@ def push_many(
     pays: jnp.ndarray,  # int32[E, P]
     enables: jnp.ndarray,  # bool[E]
 ) -> Tuple[EventQueue, jnp.ndarray]:
-    """Insert up to E events in ONE pass: the first E free slots come from
-    a single top_k over the free mask, and each queue array takes a single
-    batched scatter (events map to distinct slots, so no collisions).
-
-    This replaces E sequential (argmax + 4 scatters) rounds — each of
-    which forces a full pass over the [Q]-sized arrays — with 1 top_k +
-    4 scatters; the difference dominates step cost on large seed batches.
+    """Insert up to E events in ONE dense pass: emit ``e`` maps to the
+    e-th free slot (ascending index — the same assignment a sequential
+    first-free scan would make), computed via a cumsum rank over the free
+    mask and written with masked selects. No sort, no top_k, no scatter.
     """
     E = times.shape[0]
-    capacity = q.valid.shape[0]
     free = ~q.valid
-    idx = jnp.arange(capacity, dtype=jnp.int32)
-    # first-free-first scoring: free slot i gets capacity - i, taken get 0
-    score = jnp.where(free, capacity - idx, 0)
-    _, slots = jax.lax.top_k(score, E)
-    slot_free = jnp.take(free, slots)
-    ok = slot_free & enables
-    overflow = jnp.any(enables & ~slot_free)
+    rank = jnp.cumsum(free.astype(jnp.int32)) - 1  # rank among free slots
+    eidx = jnp.arange(E, dtype=jnp.int32)
+    sel = free[:, None] & (rank[:, None] == eidx[None, :]) & enables[None, :]  # [Q,E]
+    write = jnp.any(sel, axis=1)
+    t_new = jnp.sum(jnp.where(sel, times[None, :], jnp.int64(0)), axis=1, dtype=jnp.int64)
+    k_new = jnp.sum(jnp.where(sel, kinds[None, :], 0), axis=1, dtype=jnp.int32)
+    p_new = jnp.sum(jnp.where(sel[:, :, None], pays[None, :, :], 0), axis=1, dtype=jnp.int32)
+    num_free = jnp.sum(free.astype(jnp.int32))
+    overflow = jnp.any(enables & (eidx >= num_free))
     return (
         EventQueue(
-            time=q.time.at[slots].set(jnp.where(ok, times, q.time[slots])),
-            kind=q.kind.at[slots].set(jnp.where(ok, kinds, q.kind[slots])),
-            pay=q.pay.at[slots].set(jnp.where(ok[:, None], pays, q.pay[slots])),
-            valid=q.valid.at[slots].set(q.valid[slots] | ok),
+            time=jnp.where(write, t_new, q.time),
+            kind=jnp.where(write, k_new, q.kind),
+            pay=jnp.where(write[:, None], p_new, q.pay),
+            valid=q.valid | write,
         ),
         overflow,
     )
 
 
 def pop_min(
-    q: EventQueue, enable=True
+    q: EventQueue, enable=True, tie_u32=0
 ) -> Tuple[EventQueue, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """Remove and return the earliest event.
+    """Remove and return the earliest event; equal-time ties break
+    uniformly-at-random by ``tie_u32`` (a counter-RNG draw — deterministic
+    per seed+event, different across seeds: the reference's random ready-
+    queue pop semantics).
 
     Returns ``(queue', time, kind, pay, found)``; when the queue is empty
-    ``found`` is False and the popped fields are INVALID_TIME/0. With
-    ``enable=False`` the queue is left untouched (lets a masked-out seed
-    skip its pop without a whole-array select).
+    ``found`` is False and time is INVALID_TIME. With ``enable=False`` the
+    queue is left untouched (lets a masked-out seed skip its pop without a
+    whole-array select).
+
+    Invariant used: free slots always hold ``time == INVALID_TIME`` (make
+    + removal maintain it), so no validity masking is needed before min.
     """
-    masked = jnp.where(q.valid, q.time, INVALID_TIME)
-    slot = jnp.argmin(masked)
-    found = q.valid[slot]
-    remove = found & enable
+    capacity = q.time.shape[0]
+    t = jnp.min(q.time)
+    found = t != INVALID_TIME
+    # pseudo-random per-slot priority; argmin over candidates = random tie
+    # pick. murmur3-finalizer avalanche so any bit of the draw reshuffles
+    # the order (a plain xor would leave clustered draws order-preserving).
+    iota = jnp.arange(capacity, dtype=jnp.uint32)
+    x = iota * jnp.uint32(_HASH_MULT) ^ jnp.asarray(tie_u32, jnp.uint32)
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    prio = x ^ (x >> 16)
+    cand = q.time == t
+    # int64 sentinel strictly above any uint32 prio, so a candidate always
+    # wins even when its hash happens to be 0xFFFFFFFF
+    slot = jnp.argmin(jnp.where(cand, prio.astype(jnp.int64), jnp.int64(1) << 33))
+    mask = onehot(slot, capacity)
+    rm = mask & found & jnp.asarray(enable, bool)
+    kind = jnp.sum(jnp.where(mask & found, q.kind, 0), dtype=jnp.int32)
+    pay = jnp.sum(jnp.where(mask[:, None], q.pay, 0), axis=0, dtype=jnp.int32)
     return (
         EventQueue(
-            time=q.time.at[slot].set(jnp.where(remove, INVALID_TIME, q.time[slot])),
+            time=jnp.where(rm, INVALID_TIME, q.time),
             kind=q.kind,
             pay=q.pay,
-            valid=q.valid.at[slot].set(q.valid[slot] & ~remove),
+            valid=q.valid & ~rm,
         ),
-        masked[slot],
-        jnp.where(found, q.kind[slot], 0),
-        q.pay[slot],
+        t,
+        kind,
+        pay,
         found,
     )
 

@@ -35,6 +35,9 @@ Design notes:
 - Logs are bounded arrays (``log_cap`` entries); a seed whose log would
   overflow latches ``log_overflow`` and stops appending (surfaced in the
   sweep summary, never silent).
+- All node/log indexing is one-hot masked (engine/ops.py): under vmap,
+  dynamic scatter/gather lower to TPU ops ~6-10x slower than the dense
+  masked equivalents, and the handlers run for every seed every step.
 """
 
 from __future__ import annotations
@@ -47,6 +50,7 @@ import jax.numpy as jnp
 
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
+from ..engine.ops import get1, get2, geti, set1, set2
 from ..engine.rng import bounded, prob_to_q32
 
 # event kinds
@@ -94,6 +98,9 @@ class RaftConfig(NamedTuple):
     loss_q32: int = prob_to_q32(0.01)
     lat_lo_ns: int = 1_000_000
     lat_hi_ns: int = 10_000_000
+    # buggified latency spikes (ref net/mod.rs:287-295: 10% → 1-5 s when
+    # buggify is enabled); 0 disables
+    buggify_q32: int = 0
     history: int = 16  # election-safety ring size
 
 
@@ -147,25 +154,29 @@ def _emits(cfg: RaftConfig, bcast, *extras) -> Emits:
     """Pack N broadcast slots + 2 extra slots (timers/replies) into Emits.
 
     Each extra is ``(time, kind, pay, enable)`` or None (disabled slot);
-    every handler emits the same fixed shape (N+2 events)."""
+    every handler emits the same fixed shape (N+2 events). One
+    concatenate per field — no per-extra chains."""
     times, kinds, pays, enables = bcast
     assert len(extras) == 2
+    ets, eks, eps, eos = [], [], [], []
     for extra in extras:
         if extra is None:
-            et = jnp.zeros((), jnp.int64)
-            ek = jnp.zeros((), jnp.int32)
-            ep = jnp.zeros((PAYLOAD_SLOTS,), jnp.int32)
-            eo = jnp.zeros((), bool)
+            ets.append(jnp.zeros((), jnp.int64))
+            eks.append(jnp.zeros((), jnp.int32))
+            eps.append(jnp.zeros((PAYLOAD_SLOTS,), jnp.int32))
+            eos.append(jnp.zeros((), bool))
         else:
             et, ek, ep, eo = extra
-            et = jnp.asarray(et, jnp.int64)
-            ek = jnp.asarray(ek, jnp.int32)
-            eo = jnp.asarray(eo, bool)
-        times = jnp.concatenate([times, et[None]])
-        kinds = jnp.concatenate([kinds, ek[None]])
-        pays = jnp.concatenate([pays, ep[None]])
-        enables = jnp.concatenate([enables, eo[None]])
-    return Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+            ets.append(jnp.asarray(et, jnp.int64))
+            eks.append(jnp.asarray(ek, jnp.int32))
+            eps.append(ep)
+            eos.append(jnp.asarray(eo, bool))
+    return Emits(
+        times=jnp.concatenate([times, jnp.stack(ets)]),
+        kinds=jnp.concatenate([kinds, jnp.stack(eks)]),
+        pays=jnp.concatenate([pays, jnp.stack(eps)]),
+        enables=jnp.concatenate([enables, jnp.stack(eos)]),
+    )
 
 
 def _no_bcast(cfg: RaftConfig):
@@ -210,9 +221,9 @@ def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
     slot = w.hist_pos % cfg.history
     return w._replace(
         violation=w.violation | (won & dup),
-        hist_term=w.hist_term.at[slot].set(jnp.where(won, term, w.hist_term[slot])),
-        hist_node=w.hist_node.at[slot].set(jnp.where(won, node, w.hist_node[slot])),
-        hist_valid=w.hist_valid.at[slot].set(w.hist_valid[slot] | won),
+        hist_term=set1(w.hist_term, slot, term, won),
+        hist_node=set1(w.hist_node, slot, node, won),
+        hist_valid=set1(w.hist_valid, slot, True, won),
         hist_pos=jnp.where(won, w.hist_pos + 1, w.hist_pos),
         elections=jnp.where(won, w.elections + 1, w.elections),
     )
@@ -221,14 +232,14 @@ def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
 def _advance_commit(cfg: RaftConfig, w: RaftState, node, new_commit, enable):
     """Move ``commit[node]`` to ``new_commit`` and run the log-matching
     checker over the newly committed range."""
-    old = w.commit[node]
+    old = get1(w.commit, node)
     new = jnp.where(enable, jnp.maximum(old, new_commit.astype(jnp.int32)), old)
     idx = jnp.arange(cfg.log_cap, dtype=jnp.int32)
     fresh = (idx > old) & (idx <= new)
-    my_terms = w.log_term[node]
+    my_terms = get1(w.log_term, node)
     mismatch = jnp.any(fresh & w.chist_set & (w.chist_term != my_terms))
     return w._replace(
-        commit=w.commit.at[node].set(new),
+        commit=set1(w.commit, node, new),
         chist_term=jnp.where(fresh & ~w.chist_set, my_terms, w.chist_term),
         chist_set=w.chist_set | fresh,
         violation=w.violation | mismatch,
@@ -239,14 +250,16 @@ def _advance_commit(cfg: RaftConfig, w: RaftState, node, new_commit, enable):
 def _append_pays(cfg: RaftConfig, w: RaftState, leader, term) -> jnp.ndarray:
     """AppendEntries payloads [N, P]: each follower gets the entry at its
     next-index (or a pure heartbeat when the log has nothing newer)."""
-    nxt = w.next_idx[leader]  # [N]
+    nxt = get1(w.next_idx, leader)  # [N]
+    log_row = get1(w.log_term, leader)  # [L]
     prev_idx = nxt - 1
-    prev_term = w.log_term[leader, prev_idx]  # [N] gather
-    has_entry = nxt <= w.log_len[leader]
+    prev_term = geti(log_row, prev_idx)  # [N]
+    has_entry = nxt <= get1(w.log_len, leader)
     safe_nxt = jnp.minimum(nxt, cfg.log_cap - 1)
-    ent_term = jnp.where(has_entry, w.log_term[leader, safe_nxt], 0)
+    ent_term = jnp.where(has_entry, geti(log_row, safe_nxt), 0)
     return _pays(
-        cfg, M_APPEND, leader, term, prev_idx, prev_term, ent_term, w.commit[leader]
+        cfg, M_APPEND, leader, term, prev_idx, prev_term, ent_term,
+        get1(w.commit, leader),
     )
 
 
@@ -255,22 +268,24 @@ def _append_pays(cfg: RaftConfig, w: RaftState, leader, term) -> jnp.ndarray:
 
 def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node, gen = pay[0], pay[1]
-    valid = w.alive[node] & (gen == w.tgen[node]) & (w.role[node] != LEADER)
+    valid = get1(w.alive, node) & (gen == get1(w.tgen, node)) & (
+        get1(w.role, node) != LEADER
+    )
     # a live leader/candidate signal arrived since this timer was armed?
-    recent = (w.last_hb[node] + cfg.election_lo_ns) > now
+    recent = (get1(w.last_hb, node) + cfg.election_lo_ns) > now
     starting = valid & ~recent
 
-    new_term = w.term[node] + 1
+    new_term = get1(w.term, node) + 1
     self_bit = jnp.left_shift(jnp.uint32(1), node.astype(jnp.uint32))
     w2 = w._replace(
-        term=w.term.at[node].set(jnp.where(starting, new_term, w.term[node])),
-        role=w.role.at[node].set(jnp.where(starting, CANDIDATE, w.role[node])),
-        voted=w.voted.at[node].set(jnp.where(starting, node, w.voted[node])),
-        votes=w.votes.at[node].set(jnp.where(starting, self_bit, w.votes[node])),
-        last_hb=w.last_hb.at[node].set(jnp.where(starting, now, w.last_hb[node])),
+        term=set1(w.term, node, new_term, starting),
+        role=set1(w.role, node, CANDIDATE, starting),
+        voted=set1(w.voted, node, node, starting),
+        votes=set1(w.votes, node, self_bit, starting),
+        last_hb=set1(w.last_hb, node, now, starting),
     )
-    last_idx = w.log_len[node]
-    last_term = w.log_term[node, last_idx]
+    last_idx = get1(w.log_len, node)
+    last_term = get2(w.log_term, node, last_idx)
     bcast, sent, delivered = _broadcast(
         cfg, w2, now, node, rand, starting,
         _pays(cfg, M_REQ_VOTE, node, new_term, last_idx, last_term),
@@ -280,7 +295,7 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
         cfg,
         bcast,
         # one live election timer per node, always re-armed while valid
-        (now + timeout, K_ELECTION, _pay(node, w.tgen[node]), valid),
+        (now + timeout, K_ELECTION, _pay(node, get1(w.tgen, node)), valid),
         _DISABLED_EXTRA,
     )
     w2 = w2._replace(msgs_sent=w2.msgs_sent + sent, msgs_delivered=w2.msgs_delivered + delivered)
@@ -289,8 +304,10 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
 
 def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node, epoch = pay[0], pay[1]
-    valid = w.alive[node] & (w.role[node] == LEADER) & (epoch == w.lepoch[node])
-    term = w.term[node]
+    valid = get1(w.alive, node) & (get1(w.role, node) == LEADER) & (
+        epoch == get1(w.lepoch, node)
+    )
+    term = get1(w.term, node)
     bcast, sent, delivered = _broadcast(
         cfg, w, now, node, rand, valid, _append_pays(cfg, w, node, term)
     )
@@ -307,25 +324,28 @@ def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
 def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     dst, mtype, src, mterm = pay[0], pay[1], pay[2], pay[3]
     a, b, c, d = pay[4], pay[5], pay[6], pay[7]
-    live = w.alive[dst]
-    was_leader = live & (w.role[dst] == LEADER)
+    live = get1(w.alive, dst)
+    role_dst = get1(w.role, dst)
+    was_leader = live & (role_dst == LEADER)
 
     # term catch-up (Raft §5.1): any message with a higher term demotes
-    higher = live & (mterm > w.term[dst])
-    term_d = jnp.where(higher, mterm, w.term[dst])
-    role_d = jnp.where(higher, FOLLOWER, w.role[dst])
-    voted_d = jnp.where(higher, -1, w.voted[dst])
+    higher = live & (mterm > get1(w.term, dst))
+    term_d = jnp.where(higher, mterm, get1(w.term, dst))
+    role_d = jnp.where(higher, FOLLOWER, role_dst)
+    voted_d = jnp.where(higher, -1, get1(w.voted, dst))
 
     is_rv = live & (mtype == M_REQ_VOTE)
     is_vg = live & (mtype == M_VOTE_GRANT)
     is_ap = live & (mtype == M_APPEND)
     is_ar = live & (mtype == M_APPEND_RSP)
 
+    log_row = get1(w.log_term, dst)  # [L] this node's log terms
+    my_len = get1(w.log_len, dst)
+
     # -- RequestVote (§5.4.1 up-to-date restriction): grant iff same term,
     # not voted for anyone else, and candidate log >= ours
-    my_last_idx = w.log_len[dst]
-    my_last_term = w.log_term[dst, my_last_idx]
-    log_ok = (b > my_last_term) | ((b == my_last_term) & (a >= my_last_idx))
+    my_last_term = geti(log_row, my_len[None])[0]
+    log_ok = (b > my_last_term) | ((b == my_last_term) & (a >= my_len))
     grant = (
         is_rv
         & (mterm == term_d)
@@ -337,7 +357,7 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     # -- VoteGrant: count iff still candidate in that term
     counted = is_vg & (role_d == CANDIDATE) & (mterm == term_d)
     src_bit = jnp.left_shift(jnp.uint32(1), src.astype(jnp.uint32))
-    votes_d = jnp.where(counted, w.votes[dst] | src_bit, w.votes[dst])
+    votes_d = jnp.where(counted, get1(w.votes, dst) | src_bit, get1(w.votes, dst))
     majority = cfg.num_nodes // 2 + 1
     won = counted & (jax.lax.population_count(votes_d).astype(jnp.int32) >= majority)
     role_d = jnp.where(won, LEADER, role_d)
@@ -347,8 +367,8 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     heard = is_ap & (mterm == term_d)
     role_d = jnp.where(heard & (role_d == CANDIDATE), FOLLOWER, role_d)
     prev_idx, prev_term, ent_term, leader_commit = a, b, c, d
-    consistent = heard & (prev_idx <= w.log_len[dst]) & (
-        w.log_term[dst, prev_idx] == prev_term
+    consistent = heard & (prev_idx <= my_len) & (
+        geti(log_row, prev_idx[None])[0] == prev_term
     )
     has_entry = ent_term > 0
     slot_idx = prev_idx + 1
@@ -358,65 +378,66 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     # Raft §5.3 append rule: if the slot already holds this entry (same
     # term) keep the existing suffix; a conflicting entry truncates the
     # log at the new entry's index
-    existing_same = (slot_idx <= w.log_len[dst]) & (
-        w.log_term[dst, slot_idx] == ent_term
+    existing_same = (slot_idx <= my_len) & (
+        geti(log_row, jnp.minimum(slot_idx, cfg.log_cap - 1)[None])[0] == ent_term
     )
     new_len = jnp.where(
         store,
-        jnp.where(existing_same, w.log_len[dst], slot_idx),
-        w.log_len[dst],
+        jnp.where(existing_same, my_len, slot_idx),
+        my_len,
     )
 
+    lepoch_dst = get1(w.lepoch, dst)
     w2 = w._replace(
-        term=w.term.at[dst].set(term_d),
-        role=w.role.at[dst].set(role_d),
-        voted=w.voted.at[dst].set(voted_d),
-        votes=w.votes.at[dst].set(votes_d),
-        lepoch=w.lepoch.at[dst].set(jnp.where(won, w.lepoch[dst] + 1, w.lepoch[dst])),
-        last_hb=w.last_hb.at[dst].set(
-            jnp.where(heard | grant | won, now, w.last_hb[dst])
-        ),
-        log_term=w.log_term.at[dst, slot_idx].set(
-            jnp.where(store, ent_term, w.log_term[dst, slot_idx])
-        ),
-        log_len=w.log_len.at[dst].set(new_len),
+        term=set1(w.term, dst, term_d),
+        role=set1(w.role, dst, role_d),
+        voted=set1(w.voted, dst, voted_d),
+        votes=set1(w.votes, dst, votes_d),
+        lepoch=set1(w.lepoch, dst, lepoch_dst + 1, won),
+        last_hb=set1(w.last_hb, dst, now, heard | grant | won),
+        log_term=set2(w.log_term, dst, slot_idx, ent_term, store),
+        log_len=set1(w.log_len, dst, new_len),
         log_overflow=w.log_overflow | overflow,
     )
     w2 = _record_election(cfg, w2, term_d, dst, won)
     # follower commit: min(leader_commit, own len) once consistent
     w2 = _advance_commit(
-        cfg, w2, dst, jnp.minimum(leader_commit, w2.log_len[dst]), consistent
+        cfg, w2, dst, jnp.minimum(leader_commit, get1(w2.log_len, dst)), consistent
     )
 
     # -- AppendEntries response (leader side): update next/match, advance
     # commit under the §5.4.2 current-term rule
     rsp_ok = is_ar & (mterm == term_d) & (role_d == LEADER)
     success = a == 1
-    new_match = jnp.where(rsp_ok & success, jnp.maximum(w2.match_idx[dst, src], b),
-                          w2.match_idx[dst, src])
+    old_match = get2(w2.match_idx, dst, src)
+    old_next = get2(w2.next_idx, dst, src)
+    new_match = jnp.where(rsp_ok & success, jnp.maximum(old_match, b), old_match)
     new_next = jnp.where(
         rsp_ok,
-        jnp.where(success, new_match + 1, jnp.maximum(w2.next_idx[dst, src] - 1, 1)),
-        w2.next_idx[dst, src],
+        jnp.where(success, new_match + 1, jnp.maximum(old_next - 1, 1)),
+        old_next,
     )
     w2 = w2._replace(
-        match_idx=w2.match_idx.at[dst, src].set(new_match),
-        next_idx=w2.next_idx.at[dst, src].set(new_next),
+        match_idx=set2(w2.match_idx, dst, src, new_match),
+        next_idx=set2(w2.next_idx, dst, src, new_next),
     )
     # commit: highest idx replicated on a majority with an entry of the
     # leader's current term
     idxs = jnp.arange(cfg.log_cap, dtype=jnp.int32)
     self_mask = jnp.arange(cfg.num_nodes, dtype=jnp.int32) == dst
+    match_row = get1(w2.match_idx, dst)  # [N]
     # replicas[i] = 1 + #followers with match_idx >= i
     reps = 1 + jnp.sum(
-        (w2.match_idx[dst][None, :] >= idxs[:, None]) & ~self_mask[None, :],
+        (match_row[None, :] >= idxs[:, None]) & ~self_mask[None, :],
         axis=1, dtype=jnp.int32,
     )
+    my_len2 = get1(w2.log_len, dst)
+    log_row2 = get1(w2.log_term, dst)
     committable = (
-        (idxs <= w2.log_len[dst])
-        & (idxs > w2.commit[dst])
+        (idxs <= my_len2)
+        & (idxs > get1(w2.commit, dst))
         & (reps >= majority)
-        & (w2.log_term[dst] == term_d)
+        & (log_row2 == term_d)
     )
     best = jnp.max(jnp.where(committable, idxs, 0))
     w2 = _advance_commit(cfg, w2, dst, best, rsp_ok & (best > 0))
@@ -424,14 +445,15 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     # a leader demoted by a higher term must re-enter the election-timer
     # chain (its own timer chain ended when it fired during leadership)
     demoted = was_leader & (role_d != LEADER)
-    tgen_d = jnp.where(demoted, w.tgen[dst] + 1, w.tgen[dst])
-    w2 = w2._replace(tgen=w2.tgen.at[dst].set(tgen_d))
+    tgen_dst = get1(w.tgen, dst)
+    tgen_d = jnp.where(demoted, tgen_dst + 1, tgen_dst)
+    w2 = w2._replace(tgen=set1(w2.tgen, dst, tgen_d))
 
     # on win: reset leader bookkeeping and broadcast immediate heartbeats
-    init_next = w2.log_len[dst] + 1
+    init_next = get1(w2.log_len, dst) + 1
     w2 = w2._replace(
-        next_idx=jnp.where(won, w2.next_idx.at[dst, :].set(init_next), w2.next_idx),
-        match_idx=jnp.where(won, w2.match_idx.at[dst, :].set(0), w2.match_idx),
+        next_idx=set1(w2.next_idx, dst, init_next, won),
+        match_idx=set1(w2.match_idx, dst, 0, won),
     )
     bcast, sent, delivered = _broadcast(
         cfg, w2, now, dst, rand, won, _append_pays(cfg, w2, dst, term_d)
@@ -441,7 +463,7 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
         w.links, now, dst, src, rand[2 * cfg.num_nodes], rand[2 * cfg.num_nodes + 1]
     )
     ap_success = jnp.where(consistent, 1, 0)
-    ap_match = jnp.where(store, slot_idx, jnp.minimum(prev_idx, w2.log_len[dst]))
+    ap_match = jnp.where(store, slot_idx, jnp.minimum(prev_idx, get1(w2.log_len, dst)))
     reply_pay = jnp.where(
         grant,
         _pay(src, M_VOTE_GRANT, dst, mterm),
@@ -450,7 +472,7 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     send_reply = (grant | is_ap) & live & rdeliver
     extra_time = jnp.where(won, now + cfg.heartbeat_ns, rt)
     extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
-    extra_pay = jnp.where(won, _pay(dst, w2.lepoch[dst]), reply_pay)
+    extra_pay = jnp.where(won, _pay(dst, get1(w2.lepoch, dst)), reply_pay)
     extra_on = won | (send_reply & ~won)
     # extra slot 2: the demoted ex-leader's fresh election timer
     retimeout = bounded(
@@ -474,29 +496,29 @@ def _on_crash(cfg: RaftConfig, w: RaftState, now, pay, rand):
     # durable state (term, voted, log) survives; volatile state resets
     # (ref kill semantics: task/mod.rs:347-364 — tasks dropped, state wiped)
     w2 = w._replace(
-        alive=w.alive.at[node].set(False),
-        role=w.role.at[node].set(FOLLOWER),
-        votes=w.votes.at[node].set(jnp.uint32(0)),
-        commit=w.commit.at[node].set(0),
-        tgen=w.tgen.at[node].set(w.tgen[node] + 1),
-        lepoch=w.lepoch.at[node].set(w.lepoch[node] + 1),
+        alive=set1(w.alive, node, False),
+        role=set1(w.role, node, FOLLOWER),
+        votes=set1(w.votes, node, jnp.uint32(0)),
+        commit=set1(w.commit, node, 0),
+        tgen=set1(w.tgen, node, get1(w.tgen, node) + 1),
+        lepoch=set1(w.lepoch, node, get1(w.lepoch, node) + 1),
     )
     return w2, _emits(cfg, _no_bcast(cfg), _DISABLED_EXTRA, _DISABLED_EXTRA)
 
 
 def _on_restart(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node = pay[0]
-    was_dead = ~w.alive[node]
+    was_dead = ~get1(w.alive, node)
     w2 = w._replace(
-        alive=w.alive.at[node].set(True),
-        role=w.role.at[node].set(jnp.where(was_dead, FOLLOWER, w.role[node])),
-        last_hb=w.last_hb.at[node].set(jnp.where(was_dead, now, w.last_hb[node])),
+        alive=set1(w.alive, node, True),
+        role=set1(w.role, node, FOLLOWER, was_dead),
+        last_hb=set1(w.last_hb, node, now, was_dead),
     )
     timeout = bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns)
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (now + timeout, K_ELECTION, _pay(node, w2.tgen[node]), was_dead),
+        (now + timeout, K_ELECTION, _pay(node, get1(w2.tgen, node)), was_dead),
         _DISABLED_EXTRA,
     )
     return w2, emits
@@ -507,17 +529,13 @@ def _on_cmd(cfg: RaftConfig, w: RaftState, now, pay, rand):
     live leader with log room, append an entry of its term; otherwise
     retry against the next node after cmd_retry_ns."""
     target, retries = pay[0], pay[1]
-    is_leader = w.alive[target] & (w.role[target] == LEADER)
-    slot = w.log_len[target] + 1
+    is_leader = get1(w.alive, target) & (get1(w.role, target) == LEADER)
+    slot = get1(w.log_len, target) + 1
     room = slot < cfg.log_cap
     accept = is_leader & room
     w2 = w._replace(
-        log_term=w.log_term.at[target, slot].set(
-            jnp.where(accept, w.term[target], w.log_term[target, slot])
-        ),
-        log_len=w.log_len.at[target].set(
-            jnp.where(accept, slot, w.log_len[target])
-        ),
+        log_term=set2(w.log_term, target, slot, get1(w.term, target), accept),
+        log_len=set1(w.log_len, target, slot, accept),
         log_overflow=w.log_overflow | (is_leader & ~room),
         accepted_cmds=w.accepted_cmds + jnp.where(accept, 1, 0),
     )
@@ -567,7 +585,9 @@ def _init(cfg: RaftConfig, key):
         commit=jnp.zeros((n,), jnp.int32),
         next_idx=jnp.ones((n, n), jnp.int32),
         match_idx=jnp.zeros((n, n), jnp.int32),
-        links=enet.make(n, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns),
+        links=enet.make(
+            n, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.buggify_q32
+        ),
         hist_term=jnp.zeros((cfg.history,), jnp.int32),
         hist_node=jnp.zeros((cfg.history,), jnp.int32),
         hist_valid=jnp.zeros((cfg.history,), bool),
@@ -625,10 +645,18 @@ def workload(cfg: RaftConfig = RaftConfig()) -> Workload:
 
 
 def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
-    """Engine parameters sized for this workload (queue holds worst-case
-    in-flight: N broadcasts from every node + timers + fault/cmd plans)."""
+    """Engine parameters sized for this workload.
+
+    Queue sizing: steady state holds ≤1 election timer + ≤1 heartbeat
+    timer per node, ≤1 in-flight broadcast (N-1 messages) per node plus
+    replies, and the pending fault/command plan. 2N² + plans covers that
+    with ~2x headroom (measured high-water at N=5 is ~30; overflow is a
+    sticky per-seed flag and ``qmax`` reports the real high-water mark,
+    so an undersized queue is observable, never silent)."""
     defaults = dict(
-        queue_capacity=max(64, 4 * cfg.num_nodes * cfg.num_nodes + cfg.commands),
+        queue_capacity=max(
+            48, 2 * cfg.num_nodes * cfg.num_nodes + cfg.commands + 2 * cfg.crashes
+        ),
         time_limit_ns=10_000_000_000,
         max_steps=200_000,
     )
@@ -650,6 +678,7 @@ def sweep_summary(final) -> dict:
         "accepted_cmds": int(np.sum(np.asarray(w.accepted_cmds))),
         "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
         "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
+        "queue_high_water": int(np.max(np.asarray(final.qmax))),
         "events_total": int(np.sum(np.asarray(final.ctr))),
         "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
         "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),

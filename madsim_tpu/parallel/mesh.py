@@ -46,8 +46,16 @@ def sharded_step(workload: Workload, cfg: EngineConfig, mesh: Mesh):
     """Build the per-iteration sharded step: advances every local seed one
     event and returns the global number of still-live seeds via ``psum``."""
 
-    def local_step(state: EngineState):
-        state = jax.vmap(partial(step_one, workload, cfg))(state)
+    def local_step(state: EngineState, n_steps):
+        # up to cond_interval engine steps per invocation (finished seeds
+        # are frozen no-ops; the caller clamps n_steps so the max_steps
+        # budget is exact) — the cross-device psum amortizes over the chunk
+        state = jax.lax.fori_loop(
+            0,
+            n_steps,
+            lambda _, s: jax.vmap(partial(step_one, workload, cfg))(s),
+            state,
+        )
         live = jnp.sum(~state.done, dtype=jnp.int32)
         return state, jax.lax.psum(live, SEED_AXIS)
 
@@ -58,7 +66,7 @@ def sharded_step(workload: Workload, cfg: EngineConfig, mesh: Mesh):
     return jax.shard_map(
         local_step,
         mesh=mesh,
-        in_specs=(P(SEED_AXIS),),
+        in_specs=(P(SEED_AXIS), P()),
         out_specs=(P(SEED_AXIS), P()),
         check_vma=False,
     )
@@ -84,8 +92,9 @@ def run_sweep_sharded(
 
         def body(carry):
             state, _, iters = carry
-            state, live = step(state)
-            return state, live, iters + 1
+            n = jnp.minimum(cfg.cond_interval, cfg.max_steps - iters)
+            state, live = step(state, n)
+            return state, live, iters + n
 
         state, _, _ = jax.lax.while_loop(
             cond, body, (state, jnp.int32(seeds.shape[0]), jnp.zeros((), jnp.int64))

@@ -1,0 +1,95 @@
+"""Phase-level profiling of the engine step on the current backend.
+
+Times each component of step_batch in isolation (jitted, vmapped over the
+same seed batch) plus the full step, so the dominant cost is measurable
+rather than guessed. Run on TPU:  python scripts/profile_step.py [S]
+"""
+
+import sys
+import time
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from madsim_tpu.engine import core, queue as equeue
+from madsim_tpu.engine.rng import event_bits
+from madsim_tpu.models import raft
+
+S = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+
+cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000)
+wl = raft.workload(cfg)
+
+seeds = jnp.arange(S, dtype=jnp.int64)
+state = jax.jit(partial(core.init_sweep, wl, ecfg))(seeds)
+jax.block_until_ready(state)
+
+
+def timeit(name, fn, *args, n=20):
+    out = fn(*args)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    dt = (time.perf_counter() - t0) / n
+    print(f"{name:28s} {dt*1e3:8.3f} ms")
+    return out
+
+
+# full step
+step = jax.jit(partial(core.step_batch, wl, ecfg))
+timeit("step_batch (full)", step, state)
+
+# pop only
+pop = jax.jit(jax.vmap(lambda q: equeue.pop_min(q)))
+timeit("pop_min", pop, state.queue)
+
+# rng only
+rng = jax.jit(jax.vmap(lambda k, c: event_bits(k, c, wl.num_rand + 1)))
+timeit("event_bits", rng, state.key, state.ctr)
+
+# handler only (all six branches under vmapped switch)
+_, _, kind0, pay0, _ = jax.vmap(lambda q: equeue.pop_min(q))(state.queue)
+rand0 = jax.vmap(lambda k, c: event_bits(k, c, wl.num_rand + 1))(state.key, state.ctr)
+
+
+def handler_only(wstate, now, kind, pay, rand):
+    return wl.handle(wstate, now, kind, pay, rand)
+
+
+h = jax.jit(jax.vmap(handler_only))
+wstate2, emits = timeit(
+    "handler (6-way switch)", h, state.wstate, state.now_ns, kind0, pay0, rand0[:, 1:]
+)
+
+# each branch alone, forced kind
+for k, nm in [(0, "election"), (1, "heartbeat"), (2, "msg"), (3, "crash"), (5, "cmd")]:
+    hk = jax.jit(
+        jax.vmap(
+            lambda wstate, now, pay, rand, _k=k: wl.handle(
+                wstate, now, jnp.int32(_k), pay, rand
+            )
+        )
+    )
+    timeit(f"handler kind={nm}", hk, state.wstate, state.now_ns, pay0, rand0[:, 1:])
+
+# push only
+pm = jax.jit(
+    jax.vmap(lambda q, e: equeue.push_many(q, e.times, e.kinds, e.pays, e.enables))
+)
+timeit("push_many (top_k)", pm, state.queue, emits)
+
+# select tree only (the done-mask select over wstate)
+sel = jax.jit(
+    jax.vmap(
+        lambda p, a, b: jax.tree.map(lambda x, y: jnp.where(p, x, y), a, b)
+    )
+)
+timeit("wstate select tree", sel, state.done, wstate2, state.wstate)
+
+print(f"\nbatch={S}, backend={jax.default_backend()}")

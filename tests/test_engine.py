@@ -249,3 +249,84 @@ def test_raft_total_partition_no_leader():
     )
     s = raft.sweep_summary(final)
     assert s["no_leader_seeds"] == 4
+
+
+# -- random tie-breaking (ref mpsc.rs:71-84 random-pop semantics) ----------
+
+
+def test_pop_tie_break_varies_with_draw():
+    """Equal-time events pop in different orders for different tie draws,
+    and identically for the same draw (deterministic per seed+event)."""
+    def fill():
+        q = equeue.make(8, 1)
+        for k in range(4):
+            q, _ = equeue.push(
+                q, jnp.int64(100), jnp.int32(k),
+                jnp.array([k], jnp.int32), jnp.asarray(True),
+            )
+        return q
+
+    def pop_order(tie_seq):
+        q = fill()
+        order = []
+        for u in tie_seq:
+            q, t, kind, pay, found = equeue.pop_min(q, tie_u32=jnp.uint32(u))
+            assert bool(found) and int(t) == 100
+            order.append(int(kind))
+        return order
+
+    a = pop_order([0x12345678, 0x9E3779B9, 0xDEADBEEF, 7])
+    b = pop_order([0x12345678, 0x9E3779B9, 0xDEADBEEF, 7])
+    assert a == b, "same draws must give the same order"
+    assert sorted(a) == [0, 1, 2, 3], "all tied events must pop exactly once"
+    orders = {tuple(pop_order([u, u + 1, u + 2, u + 3])) for u in range(12)}
+    assert len(orders) > 1, "tie order must vary across draws"
+
+
+def test_pop_tie_break_prefers_earlier_time():
+    """The tie-break only applies within the minimum time bucket."""
+    q = equeue.make(4, 1)
+    for t, k in [(200, 0), (100, 1), (200, 2)]:
+        q, _ = equeue.push(
+            q, jnp.int64(t), jnp.int32(k), jnp.array([k], jnp.int32),
+            jnp.asarray(True),
+        )
+    for u in (0, 1, 0xFFFFFFFF, 0x13572468):
+        _, t, kind, _, found = equeue.pop_min(q, tie_u32=jnp.uint32(u))
+        assert bool(found) and int(t) == 100 and int(kind) == 1
+
+
+def test_same_timestamp_events_interleave_across_seeds():
+    """Two events scheduled at the identical timestamp are dispatched in
+    seed-dependent order — the device analogue of the reference's random
+    ready-queue pop (schedule amplification across a sweep)."""
+    from madsim_tpu.engine.core import Emits, Workload
+
+    def init(key):
+        w = jnp.zeros((2,), jnp.int32)  # dispatch log: order of kinds
+        emits = Emits(
+            times=jnp.array([1000, 1000], jnp.int64),
+            kinds=jnp.array([1, 2], jnp.int32),
+            pays=jnp.zeros((2, 1), jnp.int32),
+            enables=jnp.ones((2,), bool),
+        )
+        return w, emits
+
+    def handle(w, now, kind, pay, rand):
+        slot = jnp.where(w[0] == 0, 0, 1)
+        w = jnp.where(jnp.arange(2) == slot, kind, w)
+        return w, Emits(
+            times=jnp.zeros((1,), jnp.int64),
+            kinds=jnp.zeros((1,), jnp.int32),
+            pays=jnp.zeros((1, 1), jnp.int32),
+            enables=jnp.zeros((1,), bool),
+        )
+
+    wl = Workload(init=init, handle=handle, num_rand=1, payload_slots=1, max_emits=1)
+    cfg = EngineConfig(queue_capacity=4, time_limit_ns=10_000, max_steps=8,
+                       cond_interval=1)
+    final = ecore.run_sweep(wl, cfg, jnp.arange(64, dtype=jnp.int64))
+    first = np.asarray(final.wstate)[:, 0]
+    assert set(first.tolist()) == {1, 2}, (
+        "across seeds both orders of the tied pair must occur"
+    )
