@@ -37,7 +37,14 @@ def load_sweep(path: str, like: EngineState) -> EngineState:
     """Restore a checkpoint; ``like`` supplies the pytree structure (build
     it with ``init_sweep`` on any seed vector of the same shape/config)."""
     data = np.load(path)
-    assert int(data["__version__"]) == _FORMAT_VERSION
+    found = int(data["__version__"])
+    if found != _FORMAT_VERSION:
+        raise ValueError(
+            f"checkpoint format version mismatch: {path} is v{found}, "
+            f"this engine reads v{_FORMAT_VERSION} (the draw layout / state "
+            "schema changed between versions; re-run the sweep to produce a "
+            "fresh checkpoint)"
+        )
     leaves, treedef = jax.tree.flatten(like)
     out = []
     for i, leaf in enumerate(leaves):

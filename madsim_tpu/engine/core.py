@@ -113,6 +113,12 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
             f"queue_capacity ({cfg.queue_capacity}); every handler "
             "invocation must be able to enqueue its full emit batch"
         )
+    if cfg.cond_interval < 1:
+        raise ValueError(
+            f"cond_interval must be >= 1, got {cfg.cond_interval} (the "
+            "sweep loop body runs cond_interval steps per termination "
+            "check; zero would make the loop spin forever)"
+        )
     key = seed_key(seed)
     wstate, emits = workload.init(key)
     q = equeue.make(cfg.queue_capacity, workload.payload_slots)

@@ -17,8 +17,14 @@ handle) — the same property the reference gets from thread-local context.
 Intercepted:  ``random.*`` (module-level functions), ``os.urandom``,
 ``uuid.uuid4``, ``time.{time,time_ns,monotonic,monotonic_ns,perf_counter,
 perf_counter_ns}``, ``threading.Thread.start`` (blocked in sim unless
-allowed), ``os.cpu_count`` (reports the node's configured cores).  Known gap (documented): ``datetime.datetime.now`` reads the OS
-clock from C and cannot be patched — use ``madsim_tpu.time.now()``.
+allowed), ``os.cpu_count`` (reports the node's configured cores), and
+``datetime.datetime`` / ``datetime.date`` (module attributes swapped for
+sim-aware subclasses whose ``now``/``utcnow``/``today`` read the virtual
+clock; the C methods themselves are unpatchable).  Remaining gap: code
+that ran ``from datetime import datetime`` *before* the sim started holds
+the original class — its ``now()`` reads the OS clock.  Sim-aware
+``datetime.now()`` returns UTC-based naive time so results don't depend
+on the host machine's timezone database.
 """
 
 from __future__ import annotations
@@ -145,6 +151,57 @@ def _sim_thread_start(self: threading.Thread, *args: Any, **kwargs: Any) -> Any:
     return _originals["threading.Thread.start"](self, *args, **kwargs)
 
 
+def _make_datetime_classes():
+    """Sim-aware ``datetime``/``date`` subclasses (built lazily at install
+    so the saved originals are whatever the process currently has).
+
+    The reference fixes this whole class of leak at the libc boundary —
+    ``clock_gettime``/``gettimeofday`` overrides (sim/time/system_time.rs:
+    4-113) — which Python cannot do; swapping the module attributes is the
+    closest interposition point above the C layer.
+    """
+    import datetime as _dt
+
+    real_datetime = _originals["datetime.datetime"]
+    real_date = _originals["datetime.date"]
+
+    class SimDateTime(real_datetime):  # type: ignore[valid-type, misc]
+        @classmethod
+        def now(cls, tz=None):
+            h = try_current_handle()
+            if h is None:
+                return real_datetime.now(tz)
+            ts = h.time.now_time_ns() / 1e9
+            if tz is not None:
+                return cls.fromtimestamp(ts, tz)
+            # UTC-based naive: local-tz conversion would make the same seed
+            # produce different datetimes on differently-configured hosts
+            return cls.fromtimestamp(ts, _dt.timezone.utc).replace(tzinfo=None)
+
+        @classmethod
+        def utcnow(cls):
+            h = try_current_handle()
+            if h is None:
+                return real_datetime.utcnow()
+            ts = h.time.now_time_ns() / 1e9
+            return cls.fromtimestamp(ts, _dt.timezone.utc).replace(tzinfo=None)
+
+        @classmethod
+        def today(cls):
+            return cls.now()
+
+    class SimDate(real_date):  # type: ignore[valid-type, misc]
+        @classmethod
+        def today(cls):
+            h = try_current_handle()
+            if h is None:
+                return real_date.today()
+            d = SimDateTime.now()
+            return cls(d.year, d.month, d.day)
+
+    return SimDateTime, SimDate
+
+
 def _sim_cpu_count() -> Any:
     """Inside a sim task, report the node's configured cores — the
     analogue of the reference faking ``available_parallelism`` via
@@ -158,9 +215,16 @@ def _sim_cpu_count() -> Any:
 
 
 def _install() -> None:
+    import datetime as _dt
     import random as _r
     import time as _t
 
+    _originals.update(
+        {
+            "datetime.datetime": _dt.datetime,
+            "datetime.date": _dt.date,
+        }
+    )
     _originals.update(
         {
             "random.random": _r.random,
@@ -201,11 +265,16 @@ def _install() -> None:
     _t.perf_counter = _make_clock("time.perf_counter", "mono", ns=False)
     _t.perf_counter_ns = _make_clock("time.perf_counter_ns", "mono", ns=True)
     threading.Thread.start = _sim_thread_start  # type: ignore[method-assign]
+    _dt.datetime, _dt.date = _make_datetime_classes()
 
 
 def _uninstall() -> None:
+    import datetime as _dt
     import random as _r
     import time as _t
+
+    _dt.datetime = _originals["datetime.datetime"]
+    _dt.date = _originals["datetime.date"]
 
     _r.random = _originals["random.random"]
     _r.getrandbits = _originals["random.getrandbits"]

@@ -45,17 +45,20 @@ def timeit(name, fn, *args, n=20):
 step = jax.jit(partial(core.step_batch, wl, ecfg))
 timeit("step_batch (full)", step, state)
 
-# pop only
-pop = jax.jit(jax.vmap(lambda q: equeue.pop_min(q)))
-timeit("pop_min", pop, state.queue)
+# rng only — the engine draws num_rand + 2 words per event (rand[0] clock
+# jitter, rand[1] pop tie-break, rand[2:] handler draws; engine/core.py
+# _pop_event)
+rng = jax.jit(jax.vmap(lambda k, c: event_bits(k, c, wl.num_rand + 2)))
+rand0 = timeit("event_bits", rng, state.key, state.ctr)
 
-# rng only
-rng = jax.jit(jax.vmap(lambda k, c: event_bits(k, c, wl.num_rand + 1)))
-timeit("event_bits", rng, state.key, state.ctr)
+# pop only (with the tie-break draw, as the real step does)
+pop = jax.jit(jax.vmap(lambda q, t: equeue.pop_min(q, tie_u32=t)))
+timeit("pop_min (tie-break)", pop, state.queue, rand0[:, 1])
 
 # handler only (all six branches under vmapped switch)
-_, _, kind0, pay0, _ = jax.vmap(lambda q: equeue.pop_min(q))(state.queue)
-rand0 = jax.vmap(lambda k, c: event_bits(k, c, wl.num_rand + 1))(state.key, state.ctr)
+_, _, kind0, pay0, _ = jax.vmap(lambda q, t: equeue.pop_min(q, tie_u32=t))(
+    state.queue, rand0[:, 1]
+)
 
 
 def handler_only(wstate, now, kind, pay, rand):
@@ -64,7 +67,7 @@ def handler_only(wstate, now, kind, pay, rand):
 
 h = jax.jit(jax.vmap(handler_only))
 wstate2, emits = timeit(
-    "handler (6-way switch)", h, state.wstate, state.now_ns, kind0, pay0, rand0[:, 1:]
+    "handler (6-way switch)", h, state.wstate, state.now_ns, kind0, pay0, rand0[:, 2:]
 )
 
 # each branch alone, forced kind
@@ -76,13 +79,13 @@ for k, nm in [(0, "election"), (1, "heartbeat"), (2, "msg"), (3, "crash"), (5, "
             )
         )
     )
-    timeit(f"handler kind={nm}", hk, state.wstate, state.now_ns, pay0, rand0[:, 1:])
+    timeit(f"handler kind={nm}", hk, state.wstate, state.now_ns, pay0, rand0[:, 2:])
 
 # push only
 pm = jax.jit(
     jax.vmap(lambda q, e: equeue.push_many(q, e.times, e.kinds, e.pays, e.enables))
 )
-timeit("push_many (top_k)", pm, state.queue, emits)
+timeit("push_many (rank-select)", pm, state.queue, emits)
 
 # select tree only (the done-mask select over wstate)
 sel = jax.jit(

@@ -150,3 +150,31 @@ def test_sweep_checkpoint_resume_bit_exact(tmp_path):
     assert jnp.array_equal(resumed.now_ns, full.now_ns)
     assert jnp.array_equal(resumed.wstate.elections, full.wstate.elections)
     assert jnp.array_equal(resumed.wstate.violation, full.wstate.violation)
+
+
+def test_checkpoint_version_mismatch_raises(tmp_path):
+    import numpy as np
+    import pytest
+
+    cfg = raft.RaftConfig(num_nodes=3)
+    ecfg = raft.engine_config(cfg, queue_capacity=32)
+    wl = raft.workload(cfg)
+    state = ecore.init_sweep(wl, ecfg, jnp.arange(2, dtype=jnp.int64))
+    path = str(tmp_path / "old.npz")
+    checkpoint.save_sweep(state, path)
+    # rewrite with a stale version stamp
+    data = dict(np.load(path))
+    data["__version__"] = np.asarray(1)
+    np.savez_compressed(path, **data)
+    with pytest.raises(ValueError, match="version mismatch"):
+        checkpoint.load_sweep(path, state)
+
+
+def test_cond_interval_validated():
+    import pytest
+
+    cfg = raft.RaftConfig(num_nodes=3)
+    ecfg = raft.engine_config(cfg)._replace(cond_interval=0)
+    wl = raft.workload(cfg)
+    with pytest.raises(ValueError, match="cond_interval"):
+        ecore.init_sweep(wl, ecfg, jnp.arange(2, dtype=jnp.int64))

@@ -66,6 +66,34 @@ def test_stdlib_time_interposed():
     assert 5.0 <= dt < 5.01  # virtual, not wall time
 
 
+def test_datetime_interposed():
+    """datetime.datetime.now()/date.today() inside the sim read the virtual
+    clock (the clock_gettime analogue, ref sim/time/system_time.rs:4-113);
+    outside, the real classes are restored."""
+    import datetime
+
+    real_cls = datetime.datetime
+
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+
+        async def main():
+            import datetime as dt
+
+            a = dt.datetime.now()
+            await ms.sleep(90.0)
+            b = dt.datetime.now()
+            assert 90.0 <= (b - a).total_seconds() < 90.01  # virtual time
+            assert dt.date.today() == a.date()
+            return a.isoformat(), dt.datetime.utcnow().isoformat()
+
+        return rt.block_on(main())
+
+    assert run(11) == run(11)
+    assert run(11) != run(12)
+    assert datetime.datetime is real_cls  # restored outside the sim
+
+
 def test_interpose_restored_outside_sim():
     import random
     import time as stdtime
