@@ -165,7 +165,25 @@ def _make_datetime_classes():
     real_datetime = _originals["datetime.datetime"]
     real_date = _originals["datetime.date"]
 
-    class SimDateTime(real_datetime):  # type: ignore[valid-type, misc]
+    # isinstance/issubclass against the swapped classes must behave exactly
+    # like checks against the real ones (freezegun-style): a real datetime
+    # created before the swap is an instance of SimDateTime, and
+    # SimDateTime.now() is an instance of SimDate (datetime ⊂ date holds).
+    # Without this, serializer-style `isinstance(x, datetime.date)` dispatch
+    # would take different branches inside vs outside the sim.
+    def _delegating_meta(real_cls):
+        class _Meta(type):
+            def __instancecheck__(cls, obj):
+                return isinstance(obj, real_cls)
+
+            def __subclasscheck__(cls, sub):
+                return issubclass(sub, real_cls)
+
+        return _Meta
+
+    class SimDateTime(
+        real_datetime, metaclass=_delegating_meta(real_datetime)
+    ):  # type: ignore[valid-type, misc]
         @classmethod
         def now(cls, tz=None):
             h = try_current_handle()
@@ -190,7 +208,9 @@ def _make_datetime_classes():
         def today(cls):
             return cls.now()
 
-    class SimDate(real_date):  # type: ignore[valid-type, misc]
+    class SimDate(
+        real_date, metaclass=_delegating_meta(real_date)
+    ):  # type: ignore[valid-type, misc]
         @classmethod
         def today(cls):
             h = try_current_handle()

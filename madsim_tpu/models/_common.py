@@ -1,0 +1,68 @@
+"""Shared emit-packing helpers for device workload models.
+
+Every model hands the engine a fixed-shape ``Emits`` batch per handler
+invocation: ``num_nodes`` broadcast slots (one potential message per
+destination node) followed by two "extra" slots (timer re-arms, unicast
+replies). These helpers own that packing protocol in one place so the
+models stay in sync with the engine's ``Emits`` contract.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax.numpy as jnp
+
+from ..engine.core import Emits
+
+# sentinel for an unused extra slot
+DISABLED = None
+
+ExtraSlot = Optional[Tuple]  # (time, kind, pay, enable) or DISABLED
+
+
+def pay(*vals, slots: int) -> jnp.ndarray:
+    """Pack scalar values into an int32 payload vector of ``slots`` width."""
+    out = jnp.zeros((slots,), jnp.int32)
+    for i, v in enumerate(vals):
+        out = out.at[i].set(jnp.asarray(v, jnp.int32))
+    return out
+
+
+def no_bcast(num_nodes: int, payload_slots: int, msg_kind: int):
+    """An all-disabled broadcast block (still shaped [num_nodes])."""
+    return (
+        jnp.zeros((num_nodes,), jnp.int64),
+        jnp.full((num_nodes,), msg_kind, jnp.int32),
+        jnp.zeros((num_nodes, payload_slots), jnp.int32),
+        jnp.zeros((num_nodes,), bool),
+    )
+
+
+def pack_emits(payload_slots: int, bcast, *extras: ExtraSlot) -> Emits:
+    """Pack ``num_nodes`` broadcast slots + 2 extra slots into ``Emits``.
+
+    Each extra is ``(time, kind, pay, enable)`` or ``DISABLED``; every
+    handler emits the same fixed shape (num_nodes + 2 events). One
+    concatenate per field — no per-extra chains."""
+    times, kinds, pays, enables = bcast
+    assert len(extras) == 2
+    ets, eks, eps, eos = [], [], [], []
+    for extra in extras:
+        if extra is None:
+            ets.append(jnp.zeros((), jnp.int64))
+            eks.append(jnp.zeros((), jnp.int32))
+            eps.append(jnp.zeros((payload_slots,), jnp.int32))
+            eos.append(jnp.zeros((), bool))
+        else:
+            et, ek, ep, eo = extra
+            ets.append(jnp.asarray(et, jnp.int64))
+            eks.append(jnp.asarray(ek, jnp.int32))
+            eps.append(ep)
+            eos.append(jnp.asarray(eo, bool))
+    return Emits(
+        times=jnp.concatenate([times, jnp.stack(ets)]),
+        kinds=jnp.concatenate([kinds, jnp.stack(eks)]),
+        pays=jnp.concatenate([pays, jnp.stack(eps)]),
+        enables=jnp.concatenate([enables, jnp.stack(eos)]),
+    )

@@ -1,0 +1,548 @@
+"""Kafka broker/producer/consumer as a device workload — BASELINE config #4.
+
+A single-broker Kafka cluster — per-partition append-only logs with
+log-end-offset and durable (flushed) watermark bookkeeping, producers with
+retry-until-ack delivery, consumers polling up to the high watermark — with
+broker crash/restart fault injection and per-message loss/latency, expressed
+as pure array handlers so thousands of seeds run in lockstep on TPU. It is
+the second device model after Raft (models/raft.py) and proves the engine
+generalizes beyond consensus: same queue/RNG/net substrate, a completely
+different actor topology.
+
+Behavior modeled from the reference broker state machine
+(madsim-rdkafka/src/sim/broker.rs:80-146 — produce appends at
+log_end_offset, fetch reads a bounded batch from an offset, watermarks =
+(base, log_end)) plus the crash/restart semantics the reference applies to
+any node (madsim/src/sim/task/mod.rs:347-394): on crash the broker loses
+every entry newer than its durable watermark, on restart it resumes from
+durable state.
+
+Online invariant checkers (any breach latches ``violation``):
+- **no acked-message loss**: at crash time, every sequence number the
+  broker has acknowledged must have a durable copy (``ack_upto <=
+  dur_upto`` per producer). The static ``bug_ack_on_append`` flag makes the
+  broker ack on append instead of at flush — the classic
+  ack-before-durable bug — which this checker catches at a reported seed.
+- **watermark sanity**: the durable watermark never exceeds the log end
+  (``flushed <= log_len``), checked at every flush and crash.
+- **fetch contiguity / offset monotonicity**: consumers only advance their
+  offset on a response matching their current position, so the consumed
+  stream is gap-free; the broker never serves past the durable watermark.
+
+Design notes (shared with models/raft.py):
+- All node/log indexing is one-hot masked (engine/ops.py) — no dynamic
+  scatter/gather on the hot path.
+- Timer staleness uses generation counters (``bgen`` guards the broker's
+  flush-timer chain across crash/restart); producer/consumer timer chains
+  are self-re-arming.
+- Acks are *cumulative* (ack_upto = highest acked seq): producers send
+  seq k until acked, so per-producer append order has no gaps and a single
+  int32 per producer replaces a set.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import net as enet
+from ..engine.core import Emits, EngineConfig, Workload
+from ..engine.ops import get1, set1, set2
+from ..engine.rng import bounded, prob_to_q32
+from . import _common
+
+# event kinds
+K_PRODUCE = 0  # pay = (producer,) — producer timer: send next unacked seq
+K_FETCH = 1  # pay = (consumer,) — consumer timer: poll from current offset
+K_MSG = 2  # pay = (dst_node, mtype, src_node, a, b, c)
+K_FLUSH = 3  # pay = (bgen,) — broker durability timer
+K_CRASH = 4  # broker crash (fault plan)
+K_RESTART = 5  # broker restart
+
+# message types (pay slots a/b/c per type)
+MT_PRODUCE = 0  # a = seq
+MT_ACK = 1  # a = ack_upto (cumulative)
+MT_FETCH = 2  # a = offset
+MT_FETCH_RSP = 3  # a = start_offset, b = num_records
+
+PAYLOAD_SLOTS = 6
+BROKER = 0  # node id of the broker
+
+
+class KafkaConfig(NamedTuple):
+    """Static sweep parameters (hashable — part of the jit key)."""
+
+    num_producers: int = 2
+    num_consumers: int = 2
+    partitions: int = 2
+    msgs_per_producer: int = 16
+    log_cap: int = 64  # per-partition entry capacity (retries duplicate)
+    # producer retry cadence: resend the lowest unacked seq until acked
+    produce_lo_ns: int = 30_000_000
+    produce_hi_ns: int = 80_000_000
+    # consumer poll cadence
+    fetch_lo_ns: int = 40_000_000
+    fetch_hi_ns: int = 120_000_000
+    fetch_max: int = 4  # records per fetch response
+    # broker durability cadence (flush marks the log durable)
+    flush_interval_ns: int = 200_000_000
+    # fault plan: broker crash/restart events in the first crash_window_ns
+    crashes: int = 1
+    crash_window_ns: int = 3_000_000_000
+    restart_lo_ns: int = 100_000_000
+    restart_hi_ns: int = 800_000_000
+    # network model (reference defaults: 1-10 ms latency)
+    loss_q32: int = prob_to_q32(0.01)
+    lat_lo_ns: int = 1_000_000
+    lat_hi_ns: int = 10_000_000
+    buggify_q32: int = 0
+    # deliberate bug for checker validation: ack on append instead of at
+    # flush — crash between append and flush loses acknowledged messages
+    bug_ack_on_append: bool = False
+
+    @property
+    def num_nodes(self) -> int:
+        return 1 + self.num_producers + self.num_consumers
+
+
+class KafkaState(NamedTuple):
+    # broker
+    alive: jnp.ndarray  # bool
+    bgen: jnp.ndarray  # int32 flush-timer generation
+    # partition logs [P, L] (entries < log_len valid; < flushed durable)
+    log_src: jnp.ndarray  # int32[P, L] producer index
+    log_seq: jnp.ndarray  # int32[P, L]
+    log_len: jnp.ndarray  # int32[P] log end offset
+    flushed: jnp.ndarray  # int32[P] durable watermark
+    # cumulative ack bookkeeping [NP] (-1 = none)
+    ack_upto: jnp.ndarray  # int32 highest seq the broker acked
+    dur_upto: jnp.ndarray  # int32 highest seq with a durable copy
+    # producers [NP]
+    next_seq: jnp.ndarray  # int32 lowest unacked seq (== M when done)
+    # consumers [NC]
+    cons_off: jnp.ndarray  # int32 next offset to fetch
+    # network
+    links: enet.LinkState
+    # sweep outputs
+    violation: jnp.ndarray  # bool (any checker)
+    vio_ack_loss: jnp.ndarray  # bool
+    vio_watermark: jnp.ndarray  # bool
+    log_overflow: jnp.ndarray  # bool
+    produced: jnp.ndarray  # int32 produce messages sent
+    appended: jnp.ndarray  # int32 entries appended at broker
+    acked: jnp.ndarray  # int32 ack messages received by producers
+    fetched: jnp.ndarray  # int32 records consumed
+    flushes: jnp.ndarray  # int32
+    crash_count: jnp.ndarray  # int32 crashes that hit a live broker
+    msgs_sent: jnp.ndarray  # int32
+    msgs_delivered: jnp.ndarray  # int32
+
+
+def _pay(*vals) -> jnp.ndarray:
+    return _common.pay(*vals, slots=PAYLOAD_SLOTS)
+
+
+_DISABLED = _common.DISABLED
+
+
+def _emits(cfg: KafkaConfig, bcast, *extras) -> Emits:
+    return _common.pack_emits(PAYLOAD_SLOTS, bcast, *extras)
+
+
+def _no_bcast(cfg: KafkaConfig):
+    return _common.no_bcast(cfg.num_nodes, PAYLOAD_SLOTS, K_MSG)
+
+
+def _producer_node(p):
+    return jnp.asarray(p, jnp.int32) + 1
+
+
+def _consumer_node(cfg: KafkaConfig, c):
+    return jnp.asarray(c, jnp.int32) + 1 + cfg.num_producers
+
+
+# -- event handlers (each: (w, now, pay, rand) -> (w, Emits)) ----------------
+
+
+def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """Producer p sends its lowest unacked seq to the broker and re-arms
+    (retry-until-ack — at-least-once delivery, duplicates possible)."""
+    p = pay[0]
+    seq = get1(w.next_seq, p)
+    active = seq < cfg.msgs_per_producer
+    node = _producer_node(p)
+    t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
+    send = active & deliver
+    msg = _pay(BROKER, MT_PRODUCE, node, seq)
+    interval = bounded(rand[2], cfg.produce_lo_ns, cfg.produce_hi_ns)
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (t, K_MSG, msg, send),
+        (now + interval, K_PRODUCE, _pay(p), active),
+    )
+    w2 = w._replace(
+        produced=w.produced + jnp.where(active, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(active, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(send, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_fetch_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """Consumer c polls the broker from its current offset and re-arms."""
+    c = pay[0]
+    node = _consumer_node(cfg, c)
+    t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
+    msg = _pay(BROKER, MT_FETCH, node, get1(w.cons_off, c))
+    interval = bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns)
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (t, K_MSG, msg, deliver),
+        (now + interval, K_FETCH, _pay(c), True),
+    )
+    w2 = w._replace(
+        msgs_sent=w.msgs_sent + 1,
+        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+    )
+    return w2, emits
+
+
+def _compute_dur_upto(cfg: KafkaConfig, log_src, log_seq, flushed):
+    """dur_upto[p] = highest seq among durable entries of producer p.
+
+    Dense [NP, P, L] masked max — per-producer append order is gap-free
+    (producers retry seq k until acked before sending k+1), so the max is
+    the cumulative durable frontier."""
+    pos = jnp.arange(cfg.log_cap, dtype=jnp.int32)[None, :]  # [1, L]
+    durable = pos < flushed[:, None]  # [P, L]
+    producers = jnp.arange(cfg.num_producers, dtype=jnp.int32)[:, None, None]
+    mine = (log_src[None, :, :] == producers) & durable[None, :, :]  # [NP,P,L]
+    return jnp.max(
+        jnp.where(mine, log_seq[None, :, :], jnp.int32(-1)), axis=(1, 2)
+    )
+
+
+def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    dst, mtype, src, a, b = pay[0], pay[1], pay[2], pay[3], pay[4]
+    at_broker = dst == BROKER
+    alive = w.alive
+
+    # -- broker: PRODUCE — append at log end (broker.rs:80-101); keyed
+    # assignment producer → partition (src is the producer's node id)
+    is_produce = at_broker & alive & (mtype == MT_PRODUCE)
+    producer = src - 1
+    part_p = producer % cfg.partitions
+    len_p = get1(w.log_len, part_p)
+    room = len_p < cfg.log_cap
+    do_append = is_produce & room
+    seq = a
+    log_src2 = set2(w.log_src, part_p, len_p, producer, do_append)
+    log_seq2 = set2(w.log_seq, part_p, len_p, seq, do_append)
+    log_len2 = set1(w.log_len, part_p, len_p + 1, do_append)
+
+    # ack policy: the deliberate bug acks on append (before the entry is
+    # durable); correct behavior acks at flush (_on_flush). Either way a
+    # *duplicate* produce of an already-acked seq re-sends the cumulative
+    # ack — the original may have been lost in the network, and without a
+    # re-send the producer would retry (and duplicate-append) forever.
+    if cfg.bug_ack_on_append:
+        new_ack_p = jnp.maximum(get1(w.ack_upto, producer), seq)
+        ack_upto2 = set1(w.ack_upto, producer, new_ack_p, do_append)
+        send_ack = do_append
+    else:
+        ack_upto2 = w.ack_upto
+        new_ack_p = get1(w.ack_upto, producer)
+        send_ack = is_produce & (seq <= new_ack_p)
+
+    # -- broker: FETCH — serve up to fetch_max records from the requested
+    # offset, bounded by the durable watermark (broker.rs:104-146 bounded
+    # fetch; watermark bound = acks-visible semantics)
+    is_fetch = at_broker & alive & (mtype == MT_FETCH)
+    consumer = src - 1 - cfg.num_producers
+    part_c = consumer % cfg.partitions
+    off = a
+    avail = get1(w.flushed, part_c)
+    nrec = jnp.clip(avail - off, 0, cfg.fetch_max)
+
+    # -- producer: ACK (cumulative) — advance next_seq past the frontier
+    is_ack = (mtype == MT_ACK) & (dst >= 1) & (dst <= cfg.num_producers)
+    ack_dst = dst - 1
+    adv = jnp.maximum(get1(w.next_seq, ack_dst), a + 1)
+    next_seq2 = set1(w.next_seq, ack_dst, adv, is_ack)
+
+    # -- consumer: FETCH_RSP — advance only on a response matching the
+    # current offset (stale responses from earlier polls are dropped),
+    # keeping the consumed stream contiguous and monotonic
+    is_rsp = (mtype == MT_FETCH_RSP) & (dst > cfg.num_producers)
+    rsp_c = dst - 1 - cfg.num_producers
+    match = is_rsp & (a == get1(w.cons_off, rsp_c))
+    cons_off2 = set1(w.cons_off, rsp_c, a + b, match)
+
+    # reply slot: ACK (produce, bug mode) or FETCH_RSP (fetch)
+    rt, rdeliver = enet.route(w.links, now, BROKER, src, rand[0], rand[1])
+    reply_pay = jnp.where(
+        is_fetch,
+        _pay(src, MT_FETCH_RSP, BROKER, off, nrec),
+        _pay(src, MT_ACK, BROKER, new_ack_p),
+    )
+    reply_on = (is_fetch | send_ack) & rdeliver
+    reply_sent = is_fetch | send_ack
+
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (rt, K_MSG, reply_pay, reply_on),
+        _DISABLED,
+    )
+    w2 = w._replace(
+        log_src=log_src2,
+        log_seq=log_seq2,
+        log_len=log_len2,
+        ack_upto=ack_upto2,
+        next_seq=next_seq2,
+        cons_off=cons_off2,
+        log_overflow=w.log_overflow | (is_produce & ~room),
+        appended=w.appended + jnp.where(do_append, 1, 0),
+        acked=w.acked + jnp.where(is_ack, 1, 0),
+        fetched=w.fetched + jnp.where(match, b, 0),
+        msgs_sent=w.msgs_sent + jnp.where(reply_sent, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(reply_on, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """Advance the durable watermark to the log end; in correct mode this
+    is also the ack point — one cumulative ack per producer whose durable
+    frontier moved."""
+    gen = pay[0]
+    valid = w.alive & (gen == w.bgen)
+    flushed2 = jnp.where(valid, w.log_len, w.flushed)
+    dur2 = jnp.where(
+        valid,
+        _compute_dur_upto(cfg, w.log_src, w.log_seq, flushed2),
+        w.dur_upto,
+    )
+    # watermark sanity: the durable watermark must not already exceed the
+    # log end when the flush fires (checked pre-update; post-update the
+    # two are equal by construction)
+    bad_wm = valid & jnp.any(w.flushed > w.log_len)
+
+    if cfg.bug_ack_on_append:
+        ack2 = w.ack_upto  # acks already went out at append time
+        advanced = jnp.zeros((cfg.num_producers,), bool)
+    else:
+        advanced = valid & (dur2 > w.ack_upto)
+        ack2 = jnp.where(advanced, dur2, w.ack_upto)
+
+    # broadcast slots: one cumulative ack per producer with a moved
+    # frontier (slots for non-producer nodes stay disabled)
+    n = cfg.num_nodes
+    u = rand[: 2 * n].reshape(n, 2)
+    times, deliver = enet.route_from(w.links, now, BROKER, u[:, 0], u[:, 1])
+    node_ids = jnp.arange(n, dtype=jnp.int32)
+    is_producer_slot = (node_ids >= 1) & (node_ids <= cfg.num_producers)
+    slot_producer = jnp.clip(node_ids - 1, 0, cfg.num_producers - 1)
+    slot_adv = jnp.take(advanced, slot_producer) & is_producer_slot
+    slot_ack = jnp.take(ack2, slot_producer)
+    pays = jnp.stack(
+        [
+            node_ids,
+            jnp.full((n,), MT_ACK, jnp.int32),
+            jnp.full((n,), BROKER, jnp.int32),
+            slot_ack,
+            jnp.zeros((n,), jnp.int32),
+            jnp.zeros((n,), jnp.int32),
+        ],
+        axis=1,
+    )
+    enables = slot_adv & deliver
+    bcast = (times, jnp.full((n,), K_MSG, jnp.int32), pays, enables)
+
+    emits = _emits(
+        cfg,
+        bcast,
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(gen), valid),
+        _DISABLED,
+    )
+    w2 = w._replace(
+        flushed=flushed2,
+        dur_upto=dur2,
+        ack_upto=ack2,
+        flushes=w.flushes + jnp.where(valid, 1, 0),
+        vio_watermark=w.vio_watermark | bad_wm,
+        violation=w.violation | bad_wm,
+        msgs_sent=w.msgs_sent + jnp.sum(slot_adv, dtype=jnp.int32),
+        msgs_delivered=w.msgs_delivered + jnp.sum(enables, dtype=jnp.int32),
+    )
+    return w2, emits
+
+
+def _on_crash(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """Broker crash: everything newer than the durable watermark is lost
+    (ref kill semantics task/mod.rs:347-364). THE checker moment: any
+    acked-but-not-durable seq is acknowledged data loss."""
+    was_alive = w.alive
+    lost_acked = jnp.any(w.ack_upto > w.dur_upto)
+    bad_wm = jnp.any(w.flushed > w.log_len)
+    w2 = w._replace(
+        alive=jnp.zeros((), bool),
+        bgen=w.bgen + jnp.where(was_alive, 1, 0),
+        log_len=jnp.where(was_alive, w.flushed, w.log_len),
+        vio_ack_loss=w.vio_ack_loss | (was_alive & lost_acked),
+        vio_watermark=w.vio_watermark | (was_alive & bad_wm),
+        violation=w.violation | (was_alive & (lost_acked | bad_wm)),
+        crash_count=w.crash_count + jnp.where(was_alive, 1, 0),
+    )
+    return w2, _emits(cfg, _no_bcast(cfg), _DISABLED, _DISABLED)
+
+
+def _on_restart(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """Broker restart from durable state; fresh flush-timer chain."""
+    was_dead = ~w.alive
+    w2 = w._replace(alive=jnp.ones((), bool))
+    emits = _emits(
+        cfg,
+        _no_bcast(cfg),
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(w.bgen), was_dead),
+        _DISABLED,
+    )
+    return w2, emits
+
+
+def _handle(cfg: KafkaConfig, w: KafkaState, now, kind, pay, rand):
+    branches = [
+        partial(_on_produce_timer, cfg),
+        partial(_on_fetch_timer, cfg),
+        partial(_on_msg, cfg),
+        partial(_on_flush, cfg),
+        partial(_on_crash, cfg),
+        partial(_on_restart, cfg),
+    ]
+    return jax.lax.switch(kind, branches, w, now, pay, rand)
+
+
+def _init(cfg: KafkaConfig, key):
+    np_, nc = cfg.num_producers, cfg.num_consumers
+    ninit = np_ + nc + 1 + 2 * cfg.crashes
+    rand = jax.random.bits(
+        jax.random.fold_in(key, 0x7FFF_FFFF), (ninit,), dtype=jnp.uint32
+    )
+    w = KafkaState(
+        alive=jnp.ones((), bool),
+        bgen=jnp.zeros((), jnp.int32),
+        log_src=jnp.full((cfg.partitions, cfg.log_cap), -1, jnp.int32),
+        log_seq=jnp.full((cfg.partitions, cfg.log_cap), -1, jnp.int32),
+        log_len=jnp.zeros((cfg.partitions,), jnp.int32),
+        flushed=jnp.zeros((cfg.partitions,), jnp.int32),
+        ack_upto=jnp.full((np_,), -1, jnp.int32),
+        dur_upto=jnp.full((np_,), -1, jnp.int32),
+        next_seq=jnp.zeros((np_,), jnp.int32),
+        cons_off=jnp.zeros((nc,), jnp.int32),
+        links=enet.make(
+            cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
+            cfg.buggify_q32,
+        ),
+        violation=jnp.zeros((), bool),
+        vio_ack_loss=jnp.zeros((), bool),
+        vio_watermark=jnp.zeros((), bool),
+        log_overflow=jnp.zeros((), bool),
+        produced=jnp.zeros((), jnp.int32),
+        appended=jnp.zeros((), jnp.int32),
+        acked=jnp.zeros((), jnp.int32),
+        fetched=jnp.zeros((), jnp.int32),
+        flushes=jnp.zeros((), jnp.int32),
+        crash_count=jnp.zeros((), jnp.int32),
+        msgs_sent=jnp.zeros((), jnp.int32),
+        msgs_delivered=jnp.zeros((), jnp.int32),
+    )
+    times = jnp.zeros((ninit,), jnp.int64)
+    kinds = jnp.zeros((ninit,), jnp.int32)
+    pays = jnp.zeros((ninit, PAYLOAD_SLOTS), jnp.int32)
+    enables = jnp.ones((ninit,), bool)
+    for p in range(np_):
+        times = times.at[p].set(bounded(rand[p], 0, cfg.produce_hi_ns))
+        kinds = kinds.at[p].set(K_PRODUCE)
+        pays = pays.at[p].set(_pay(p))
+    for c in range(nc):
+        i = np_ + c
+        times = times.at[i].set(bounded(rand[i], 0, cfg.fetch_hi_ns))
+        kinds = kinds.at[i].set(K_FETCH)
+        pays = pays.at[i].set(_pay(c))
+    # first flush tick
+    i = np_ + nc
+    times = times.at[i].set(jnp.int64(cfg.flush_interval_ns))
+    kinds = kinds.at[i].set(K_FLUSH)
+    pays = pays.at[i].set(_pay(0))
+    # broker crash/restart plan
+    base = np_ + nc + 1
+    for k in range(cfg.crashes):
+        t_crash = bounded(rand[base + 2 * k], 0, cfg.crash_window_ns)
+        delay = bounded(
+            rand[base + 2 * k + 1], cfg.restart_lo_ns, cfg.restart_hi_ns
+        )
+        times = times.at[base + 2 * k].set(t_crash)
+        kinds = kinds.at[base + 2 * k].set(K_CRASH)
+        times = times.at[base + 2 * k + 1].set(t_crash + delay)
+        kinds = kinds.at[base + 2 * k + 1].set(K_RESTART)
+    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def workload(cfg: KafkaConfig = KafkaConfig()) -> Workload:
+    """Build the engine Workload for a Kafka sweep configuration."""
+    return Workload(
+        init=partial(_init, cfg),
+        handle=partial(_handle, cfg),
+        num_rand=2 * cfg.num_nodes + 3,
+        payload_slots=PAYLOAD_SLOTS,
+        max_emits=cfg.num_nodes + 2,
+    )
+
+
+def engine_config(cfg: KafkaConfig = KafkaConfig(), **overrides) -> EngineConfig:
+    """Engine parameters sized for this workload: steady state holds one
+    timer chain per actor, ≤1 in-flight request+reply per client, ≤NP
+    flush acks, and the fault plan."""
+    defaults = dict(
+        queue_capacity=max(
+            48,
+            4 * (cfg.num_producers + cfg.num_consumers)
+            + cfg.num_nodes
+            + 2 * cfg.crashes
+            + 4,
+        ),
+        time_limit_ns=5_000_000_000,
+        max_steps=200_000,
+    )
+    defaults.update(overrides)
+    return EngineConfig(**defaults)
+
+
+def sweep_summary(final) -> dict:
+    """Host-side reduction of a finished sweep's batched EngineState."""
+    import numpy as np
+
+    w: KafkaState = final.wstate
+    return {
+        "seeds": int(final.seed.shape[0]),
+        "violations": int(np.sum(np.asarray(w.violation))),
+        "ack_loss_seeds": int(np.sum(np.asarray(w.vio_ack_loss))),
+        "watermark_seeds": int(np.sum(np.asarray(w.vio_watermark))),
+        "produced": int(np.sum(np.asarray(w.produced))),
+        "appended": int(np.sum(np.asarray(w.appended))),
+        "acked": int(np.sum(np.asarray(w.acked))),
+        "fetched": int(np.sum(np.asarray(w.fetched))),
+        "flushes": int(np.sum(np.asarray(w.flushes))),
+        "crashes": int(np.sum(np.asarray(w.crash_count))),
+        "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
+        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
+        "queue_high_water": int(np.max(np.asarray(final.qmax))),
+        "events_total": int(np.sum(np.asarray(final.ctr))),
+        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
+        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
+    }

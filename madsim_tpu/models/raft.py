@@ -52,6 +52,7 @@ from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, get2, geti, set1, set2
 from ..engine.rng import bounded, prob_to_q32
+from . import _common
 
 # event kinds
 K_ELECTION = 0  # pay = (node, tgen)
@@ -140,53 +141,19 @@ class RaftState(NamedTuple):
     msgs_delivered: jnp.ndarray  # int32
 
 
-def _pay(*vals, slots: int = PAYLOAD_SLOTS) -> jnp.ndarray:
-    out = jnp.zeros((slots,), jnp.int32)
-    for i, v in enumerate(vals):
-        out = out.at[i].set(jnp.asarray(v, jnp.int32))
-    return out
+def _pay(*vals) -> jnp.ndarray:
+    return _common.pay(*vals, slots=PAYLOAD_SLOTS)
 
 
-_DISABLED_EXTRA = None  # sentinel: an unused extra slot
+_DISABLED_EXTRA = _common.DISABLED  # sentinel: an unused extra slot
 
 
 def _emits(cfg: RaftConfig, bcast, *extras) -> Emits:
-    """Pack N broadcast slots + 2 extra slots (timers/replies) into Emits.
-
-    Each extra is ``(time, kind, pay, enable)`` or None (disabled slot);
-    every handler emits the same fixed shape (N+2 events). One
-    concatenate per field — no per-extra chains."""
-    times, kinds, pays, enables = bcast
-    assert len(extras) == 2
-    ets, eks, eps, eos = [], [], [], []
-    for extra in extras:
-        if extra is None:
-            ets.append(jnp.zeros((), jnp.int64))
-            eks.append(jnp.zeros((), jnp.int32))
-            eps.append(jnp.zeros((PAYLOAD_SLOTS,), jnp.int32))
-            eos.append(jnp.zeros((), bool))
-        else:
-            et, ek, ep, eo = extra
-            ets.append(jnp.asarray(et, jnp.int64))
-            eks.append(jnp.asarray(ek, jnp.int32))
-            eps.append(ep)
-            eos.append(jnp.asarray(eo, bool))
-    return Emits(
-        times=jnp.concatenate([times, jnp.stack(ets)]),
-        kinds=jnp.concatenate([kinds, jnp.stack(eks)]),
-        pays=jnp.concatenate([pays, jnp.stack(eps)]),
-        enables=jnp.concatenate([enables, jnp.stack(eos)]),
-    )
+    return _common.pack_emits(PAYLOAD_SLOTS, bcast, *extras)
 
 
 def _no_bcast(cfg: RaftConfig):
-    n = cfg.num_nodes
-    return (
-        jnp.zeros((n,), jnp.int64),
-        jnp.full((n,), K_MSG, jnp.int32),
-        jnp.zeros((n, PAYLOAD_SLOTS), jnp.int32),
-        jnp.zeros((n,), bool),
-    )
+    return _common.no_bcast(cfg.num_nodes, PAYLOAD_SLOTS, K_MSG)
 
 
 def _pays(cfg: RaftConfig, mtype, src, term, a=0, b=0, c=0, d=0) -> jnp.ndarray:

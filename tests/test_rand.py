@@ -94,6 +94,31 @@ def test_datetime_interposed():
     assert datetime.datetime is real_cls  # restored outside the sim
 
 
+def test_datetime_isinstance_inside_sim():
+    """The swapped classes must not change isinstance/issubclass dispatch:
+    a sim datetime is an instance of datetime.date (datetime ⊂ date), and
+    objects created before the swap are instances of the swapped classes
+    (freezegun-style delegating metaclass)."""
+    import datetime
+
+    pre_sim = datetime.datetime(2020, 1, 1)
+    rt = ms.Runtime(seed=7)
+
+    async def main():
+        import datetime as dt
+
+        now = dt.datetime.now()
+        assert isinstance(now, dt.datetime)
+        assert isinstance(now, dt.date)  # the classic serializer dispatch
+        assert isinstance(pre_sim, dt.datetime)
+        assert isinstance(pre_sim, dt.date)
+        assert issubclass(dt.datetime, dt.date)
+        assert isinstance(dt.date.today(), dt.date)
+        assert not isinstance(dt.date.today(), dt.datetime)
+
+    rt.block_on(main())
+
+
 def test_interpose_restored_outside_sim():
     import random
     import time as stdtime
