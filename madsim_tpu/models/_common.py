@@ -39,14 +39,9 @@ def no_bcast(num_nodes: int, payload_slots: int, msg_kind: int):
     )
 
 
-def pack_emits(payload_slots: int, bcast, *extras: ExtraSlot) -> Emits:
-    """Pack ``num_nodes`` broadcast slots + 2 extra slots into ``Emits``.
-
-    Each extra is ``(time, kind, pay, enable)`` or ``DISABLED``; every
-    handler emits the same fixed shape (num_nodes + 2 events). One
-    concatenate per field — no per-extra chains."""
-    times, kinds, pays, enables = bcast
-    assert len(extras) == 2
+def pack_extras(payload_slots: int, *extras: ExtraSlot) -> Emits:
+    """Pack standalone slots into an ``Emits`` of exactly ``len(extras)``
+    events. Each slot is ``(time, kind, pay, enable)`` or ``DISABLED``."""
     ets, eks, eps, eos = [], [], [], []
     for extra in extras:
         if extra is None:
@@ -61,8 +56,25 @@ def pack_emits(payload_slots: int, bcast, *extras: ExtraSlot) -> Emits:
             eps.append(ep)
             eos.append(jnp.asarray(eo, bool))
     return Emits(
-        times=jnp.concatenate([times, jnp.stack(ets)]),
-        kinds=jnp.concatenate([kinds, jnp.stack(eks)]),
-        pays=jnp.concatenate([pays, jnp.stack(eps)]),
-        enables=jnp.concatenate([enables, jnp.stack(eos)]),
+        times=jnp.stack(ets),
+        kinds=jnp.stack(eks),
+        pays=jnp.stack(eps),
+        enables=jnp.stack(eos),
+    )
+
+
+def pack_emits(payload_slots: int, bcast, *extras: ExtraSlot) -> Emits:
+    """Pack ``num_nodes`` broadcast slots + 2 extra slots into ``Emits``.
+
+    Each extra is ``(time, kind, pay, enable)`` or ``DISABLED``; every
+    handler emits the same fixed shape (num_nodes + 2 events). One
+    concatenate per field — no per-extra chains."""
+    times, kinds, pays, enables = bcast
+    assert len(extras) == 2
+    ex = pack_extras(payload_slots, *extras)
+    return Emits(
+        times=jnp.concatenate([times, ex.times]),
+        kinds=jnp.concatenate([kinds, ex.kinds]),
+        pays=jnp.concatenate([pays, ex.pays]),
+        enables=jnp.concatenate([enables, ex.enables]),
     )

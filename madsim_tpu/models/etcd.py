@@ -1,0 +1,510 @@
+"""etcd KV + lease service as a device workload — BASELINE config #2.
+
+A 3-node cluster (1 etcd server + 2 clients) — revisioned KV store with
+leases, client keepalive chains, and lease-expiry key deletion — with
+network-partition fault injection, expressed as pure array handlers so
+thousands of seeds run in lockstep on TPU. Third device model after Raft
+and Kafka: request/response against a stateful service, faults on the
+client links rather than the server process.
+
+Behavior modeled from the reference etcd sim
+(madsim-etcd-client/src/service.rs:189-485): ``ServiceInner { revision,
+kv, lease }`` — every mutation bumps the revision (service.rs put/delete
+paths), leases carry a TTL and an expiry task deletes attached keys when
+the TTL lapses without a keepalive (service.rs:27-33,466-485), and
+keepalives reset the countdown. Partition injection plays the role of the
+reference's ``clog_node`` (madsim/src/sim/net/mod.rs:163-203): a clogged
+client can't refresh its lease, so the server expires it — the classic
+etcd session-loss scenario.
+
+Online invariant checkers (any breach latches ``violation``):
+- **revision monotonicity**: every server reply carries the current
+  revision; a client observing a smaller revision than it has already
+  seen is a violation (single serializable server — the etcd guarantee).
+  The static ``bug_rev_regress`` flag makes lease expiry *decrement* the
+  revision, which this checker catches from the client side.
+- **lease-expiry correctness**: a GET must never observe a key whose
+  attached lease expired more than a grace margin ago (the margin absorbs
+  the engine's 50-100 ns dispatch jitter; the expiry event itself fires
+  exactly at the deadline). The static ``bug_skip_expiry`` flag makes the
+  expiry handler a no-op — expired keys linger and the checker catches
+  the first stale GET.
+
+Design notes:
+- Lease staleness uses generation counters (``lease_gen``): each
+  grant/keepalive bumps the generation and schedules a fresh K_EXPIRE at
+  the new deadline; stale expiry timers are pay-mismatch drops (same
+  pattern as models/raft.py timer chains).
+- A keepalive for a lease that is not live (re)grants it — clients own a
+  fixed lease slot and heartbeat it, the etcd-session usage pattern.
+- Partition windows are refcounted per victim (``part_cnt``), so
+  overlapping windows of the same client compose exactly. Overlapping
+  windows of *different* clients can still unclog each other's two shared
+  link cells early (clog_node sets whole rows/cols); the fault pattern is
+  slightly weaker in that corner, determinism is unaffected.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import net as enet
+from ..engine.core import Emits, EngineConfig, Workload
+from ..engine.ops import get1, set1
+from ..engine.rng import bounded, prob_to_q32
+from ._common import pack_extras, pay as _mkpay
+
+# event kinds
+K_OP = 0  # pay = (client,) — client op timer: send a PUT or GET
+K_KEEPALIVE = 1  # pay = (client,) — client lease-heartbeat timer
+K_MSG = 2  # pay = (dst, mtype, src, a, b, c)
+K_EXPIRE = 3  # pay = (lease, gen) — server lease-expiry deadline
+K_PART = 4  # pay = (victim,) — clog a client node
+K_HEAL = 5  # pay = (victim,)
+
+# message types
+MT_LEASE = 0  # grant-or-keepalive; a = lease id
+MT_PUT = 1  # a = key, b = val, c = lease id (-1 = none)
+MT_GET = 2  # a = key
+MT_RSP = 3  # a = revision, b = per-client reply sequence number — replies
+#             are independent datagrams here, but etcd clients read ordered
+#             responses off one gRPC stream, so the monotonicity check
+#             orders replies by the server-assigned sequence (reordered
+#             arrivals are stale and skipped, never mis-flagged)
+
+PAYLOAD_SLOTS = 6
+SERVER = 0
+
+
+class EtcdConfig(NamedTuple):
+    """Static sweep parameters (hashable — part of the jit key)."""
+
+    num_clients: int = 2
+    num_keys: int = 8
+    ttl_ns: int = 1_000_000_000
+    # client cadences
+    keepalive_lo_ns: int = 200_000_000
+    keepalive_hi_ns: int = 400_000_000
+    op_lo_ns: int = 50_000_000
+    op_hi_ns: int = 150_000_000
+    # partition plan: windows clogging one client in the first part of the run
+    partitions: int = 2
+    part_window_ns: int = 3_000_000_000
+    part_lo_ns: int = 500_000_000
+    part_hi_ns: int = 2_000_000_000
+    # expiry-check grace: absorbs dispatch jitter (≫ 100 ns, ≪ ttl)
+    grace_ns: int = 1_000_000
+    # network model
+    loss_q32: int = prob_to_q32(0.01)
+    lat_lo_ns: int = 1_000_000
+    lat_hi_ns: int = 10_000_000
+    buggify_q32: int = 0
+    # deliberate bugs for checker validation
+    bug_skip_expiry: bool = False  # expiry handler does nothing
+    bug_rev_regress: bool = False  # expiry decrements the revision
+
+    @property
+    def num_nodes(self) -> int:
+        return 1 + self.num_clients
+
+
+class EtcdState(NamedTuple):
+    # server KV [K]
+    kv_present: jnp.ndarray  # bool
+    kv_val: jnp.ndarray  # int32
+    kv_mod_rev: jnp.ndarray  # int32
+    kv_lease: jnp.ndarray  # int32 (-1 = none)
+    rev: jnp.ndarray  # int32 server revision
+    # leases [NC] (one slot per client)
+    lease_on: jnp.ndarray  # bool
+    lease_exp: jnp.ndarray  # int64
+    lease_gen: jnp.ndarray  # int32
+    # server-side per-client reply sequence [NC]
+    rsp_seq: jnp.ndarray  # int32 replies sent to this client so far
+    # clients [NC]
+    seen_rev: jnp.ndarray  # int32 revision of the newest-sequenced reply
+    seen_seq: jnp.ndarray  # int32 sequence number of that reply
+    # partition refcount [NC]: a client may sit in overlapping windows
+    part_cnt: jnp.ndarray  # int32
+    # network
+    links: enet.LinkState
+    # sweep outputs
+    violation: jnp.ndarray  # bool
+    vio_rev: jnp.ndarray  # bool (revision went backwards)
+    vio_expiry: jnp.ndarray  # bool (GET saw an expired-lease key)
+    puts: jnp.ndarray  # int32
+    gets: jnp.ndarray  # int32
+    keepalives: jnp.ndarray  # int32 (server-processed)
+    grants: jnp.ndarray  # int32 (keepalives that (re)granted)
+    expiries: jnp.ndarray  # int32 (leases actually expired)
+    keys_expired: jnp.ndarray  # int32 (keys deleted by expiry)
+    parts: jnp.ndarray  # int32 partitions applied
+    msgs_sent: jnp.ndarray  # int32
+    msgs_delivered: jnp.ndarray  # int32
+
+
+def _pay(*vals) -> jnp.ndarray:
+    return _mkpay(*vals, slots=PAYLOAD_SLOTS)
+
+
+def _emits2(slot1, slot2) -> Emits:
+    """Two-slot Emits (this model never broadcasts); each slot is
+    ``(time, kind, pay, enable)`` or None."""
+    return pack_extras(PAYLOAD_SLOTS, slot1, slot2)
+
+
+def _client_node(c):
+    return jnp.asarray(c, jnp.int32) + 1
+
+
+# -- event handlers ----------------------------------------------------------
+
+
+def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    """Client c sends a PUT (own key, lease-attached; or a shared key,
+    no lease) or a GET of a random key, then re-arms."""
+    c = pay[0]
+    node = _client_node(c)
+    t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
+    kind_draw = rand[2]
+    key_draw = bounded(rand[3], 0, cfg.num_keys).astype(jnp.int32)
+    is_put = (kind_draw & 1) == 0
+    # PUTs alternate between the client's lease key (key id == client id,
+    # lease attached) and a shared key (no lease)
+    own_key = (kind_draw & 2) == 0
+    put_key = jnp.where(own_key, c, key_draw)
+    put_lease = jnp.where(own_key, c, jnp.int32(-1))
+    val = (rand[4] >> 1).astype(jnp.int32)
+    msg = jnp.where(
+        is_put,
+        _pay(SERVER, MT_PUT, node, put_key, val, put_lease),
+        _pay(SERVER, MT_GET, node, key_draw),
+    )
+    interval = bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns)
+    emits = _emits2(
+        (t, K_MSG, msg, deliver),
+        (now + interval, K_OP, _pay(c), True),
+    )
+    w2 = w._replace(
+        msgs_sent=w.msgs_sent + 1,
+        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    """Client c heartbeats its lease and re-arms."""
+    c = pay[0]
+    node = _client_node(c)
+    t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
+    interval = bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns)
+    emits = _emits2(
+        (t, K_MSG, _pay(SERVER, MT_LEASE, node, c), deliver),
+        (now + interval, K_KEEPALIVE, _pay(c), True),
+    )
+    w2 = w._replace(
+        msgs_sent=w.msgs_sent + 1,
+        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    dst, mtype, src, a, b, c_ = pay[0], pay[1], pay[2], pay[3], pay[4], pay[5]
+    at_server = dst == SERVER
+
+    # -- server: LEASE (grant-or-keepalive) — reset the countdown, bump the
+    # generation, schedule a fresh expiry deadline (service.rs keepalive +
+    # the per-second expiry tick collapsed to an exact-deadline event)
+    is_lease = at_server & (mtype == MT_LEASE)
+    lease = a
+    was_on = get1(w.lease_on, lease)
+    new_gen = get1(w.lease_gen, lease) + 1
+    new_exp = now + cfg.ttl_ns
+    lease_on2 = set1(w.lease_on, lease, True, is_lease)
+    lease_exp2 = set1(w.lease_exp, lease, new_exp, is_lease)
+    lease_gen2 = set1(w.lease_gen, lease, new_gen, is_lease)
+
+    # -- server: PUT — one revision per mutation (service.rs put path).
+    # A PUT attaching a lease that is not live is rejected, as in etcd
+    # (grant must precede attach): without this, a client whose op timer
+    # beats its first keepalive would create a key with a dead lease.
+    is_put = at_server & (mtype == MT_PUT)
+    key, val, put_lease = a, b, c_
+    safe_put_lease = jnp.clip(put_lease, 0, cfg.num_clients - 1)
+    lease_live = (put_lease < 0) | get1(lease_on2, safe_put_lease)
+    do_put = is_put & lease_live
+    rev2 = jnp.where(do_put, w.rev + 1, w.rev)
+    kv_present2 = set1(w.kv_present, key, True, do_put)
+    kv_val2 = set1(w.kv_val, key, val, do_put)
+    kv_mod_rev2 = set1(w.kv_mod_rev, key, rev2, do_put)
+    kv_lease2 = set1(w.kv_lease, key, put_lease, do_put)
+
+    # -- server: GET — THE expiry checker moment: the key must not carry a
+    # lease that expired more than grace_ns ago (the expiry event fires at
+    # the deadline; grace absorbs dispatch jitter)
+    is_get = at_server & (mtype == MT_GET)
+    g_present = get1(kv_present2, a)
+    g_lease = get1(kv_lease2, a)
+    has_lease = g_lease >= 0
+    safe_lease = jnp.clip(g_lease, 0, cfg.num_clients - 1)
+    g_exp = get1(lease_exp2, safe_lease)
+    g_on = get1(lease_on2, safe_lease)
+    stale = (
+        is_get
+        & g_present
+        & has_lease
+        & (~g_on | (g_exp + cfg.grace_ns < now))
+    )
+
+    # -- client: RSP — revision monotonicity, checked in server-send
+    # order (replies reordered by the network are stale and skipped, as a
+    # real client reading one ordered gRPC stream would never see them)
+    is_rsp = (mtype == MT_RSP) & (dst >= 1)
+    client = dst - 1
+    newer = is_rsp & (b > get1(w.seen_seq, client))
+    regress = newer & (a < get1(w.seen_rev, client))
+    seen2 = set1(w.seen_rev, client, a, newer)
+    seen_seq2 = set1(w.seen_seq, client, b, newer)
+
+    # server replies to every request, stamped with the current revision
+    # and the per-client sequence number that orders the client-side check
+    rt, rdeliver = enet.route(w.links, now, SERVER, src, rand[0], rand[1])
+    is_req = is_lease | is_put | is_get
+    req_client = jnp.clip(src - 1, 0, cfg.num_clients - 1)
+    next_seq = get1(w.rsp_seq, req_client) + 1
+    rsp_seq2 = set1(w.rsp_seq, req_client, next_seq, is_req)
+    reply = _pay(src, MT_RSP, SERVER, rev2, next_seq)
+    # fresh expiry deadline for a (re)granted/refreshed lease
+    emits = _emits2(
+        (rt, K_MSG, reply, is_req & rdeliver),
+        (new_exp, K_EXPIRE, _pay(lease, new_gen), is_lease),
+    )
+    w2 = w._replace(
+        lease_on=lease_on2,
+        lease_exp=lease_exp2,
+        lease_gen=lease_gen2,
+        rev=rev2,
+        kv_present=kv_present2,
+        kv_val=kv_val2,
+        kv_mod_rev=kv_mod_rev2,
+        kv_lease=kv_lease2,
+        rsp_seq=rsp_seq2,
+        seen_rev=seen2,
+        seen_seq=seen_seq2,
+        vio_expiry=w.vio_expiry | stale,
+        vio_rev=w.vio_rev | regress,
+        violation=w.violation | stale | regress,
+        puts=w.puts + jnp.where(do_put, 1, 0),
+        gets=w.gets + jnp.where(is_get, 1, 0),
+        keepalives=w.keepalives + jnp.where(is_lease, 1, 0),
+        grants=w.grants + jnp.where(is_lease & ~was_on, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(is_req, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(is_req & rdeliver, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_expire(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    """Lease-expiry deadline: if the generation still matches (no keepalive
+    arrived since), drop the lease and delete every attached key
+    (service.rs:466-485)."""
+    lease, gen = pay[0], pay[1]
+    valid = get1(w.lease_on, lease) & (gen == get1(w.lease_gen, lease))
+    if cfg.bug_skip_expiry:
+        valid = jnp.zeros((), bool)
+    attached = w.kv_present & (w.kv_lease == lease)
+    n_del = jnp.sum(attached & valid, dtype=jnp.int32)
+    # one revision per expiry batch (the reference's expiry txn)
+    if cfg.bug_rev_regress:
+        rev2 = jnp.where(valid & (n_del > 0), w.rev - 1, w.rev)
+    else:
+        rev2 = jnp.where(valid & (n_del > 0), w.rev + 1, w.rev)
+    w2 = w._replace(
+        lease_on=set1(w.lease_on, lease, False, valid),
+        kv_present=w.kv_present & ~(attached & valid),
+        rev=rev2,
+        expiries=w.expiries + jnp.where(valid, 1, 0),
+        keys_expired=w.keys_expired + n_del,
+    )
+    return w2, _emits2(None, None)
+
+
+def _on_part(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    """Clog the victim's links; refcounted so overlapping windows of the
+    same victim compose (the heal of the first window must not reopen the
+    second's)."""
+    c = pay[0]
+    victim = _client_node(c)
+    cnt = get1(w.part_cnt, c)
+    links2 = jax.tree.map(
+        lambda a, b: jnp.where(cnt == 0, a, b),
+        enet.clog_node(w.links, victim),
+        w.links,
+    )
+    return (
+        w._replace(
+            links=links2,
+            part_cnt=set1(w.part_cnt, c, cnt + 1),
+            parts=w.parts + 1,
+        ),
+        _emits2(None, None),
+    )
+
+
+def _on_heal(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    c = pay[0]
+    victim = _client_node(c)
+    cnt = get1(w.part_cnt, c)
+    links2 = jax.tree.map(
+        lambda a, b: jnp.where(cnt == 1, a, b),
+        enet.unclog_node(w.links, victim),
+        w.links,
+    )
+    return (
+        w._replace(
+            links=links2,
+            part_cnt=set1(w.part_cnt, c, jnp.maximum(cnt - 1, 0)),
+        ),
+        _emits2(None, None),
+    )
+
+
+def _handle(cfg: EtcdConfig, w: EtcdState, now, kind, pay, rand):
+    branches = [
+        partial(_on_op_timer, cfg),
+        partial(_on_keepalive_timer, cfg),
+        partial(_on_msg, cfg),
+        partial(_on_expire, cfg),
+        partial(_on_part, cfg),
+        partial(_on_heal, cfg),
+    ]
+    return jax.lax.switch(kind, branches, w, now, pay, rand)
+
+
+def _init(cfg: EtcdConfig, key):
+    nc = cfg.num_clients
+    if cfg.num_keys < nc:
+        raise ValueError("num_keys must cover one lease key per client")
+    ninit = 2 * nc + 2 * cfg.partitions
+    rand = jax.random.bits(
+        jax.random.fold_in(key, 0x7FFF_FFFF),
+        (ninit + cfg.partitions,),
+        dtype=jnp.uint32,
+    )
+    w = EtcdState(
+        kv_present=jnp.zeros((cfg.num_keys,), bool),
+        kv_val=jnp.zeros((cfg.num_keys,), jnp.int32),
+        kv_mod_rev=jnp.zeros((cfg.num_keys,), jnp.int32),
+        kv_lease=jnp.full((cfg.num_keys,), -1, jnp.int32),
+        rev=jnp.zeros((), jnp.int32),
+        lease_on=jnp.zeros((nc,), bool),
+        lease_exp=jnp.zeros((nc,), jnp.int64),
+        lease_gen=jnp.zeros((nc,), jnp.int32),
+        rsp_seq=jnp.zeros((nc,), jnp.int32),
+        seen_rev=jnp.zeros((nc,), jnp.int32),
+        seen_seq=jnp.zeros((nc,), jnp.int32),
+        part_cnt=jnp.zeros((nc,), jnp.int32),
+        links=enet.make(
+            cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
+            cfg.buggify_q32,
+        ),
+        violation=jnp.zeros((), bool),
+        vio_rev=jnp.zeros((), bool),
+        vio_expiry=jnp.zeros((), bool),
+        puts=jnp.zeros((), jnp.int32),
+        gets=jnp.zeros((), jnp.int32),
+        keepalives=jnp.zeros((), jnp.int32),
+        grants=jnp.zeros((), jnp.int32),
+        expiries=jnp.zeros((), jnp.int32),
+        keys_expired=jnp.zeros((), jnp.int32),
+        parts=jnp.zeros((), jnp.int32),
+        msgs_sent=jnp.zeros((), jnp.int32),
+        msgs_delivered=jnp.zeros((), jnp.int32),
+    )
+    times = jnp.zeros((ninit,), jnp.int64)
+    kinds = jnp.zeros((ninit,), jnp.int32)
+    pays = jnp.zeros((ninit, PAYLOAD_SLOTS), jnp.int32)
+    enables = jnp.ones((ninit,), bool)
+    for c in range(nc):
+        # keepalive chain starts early (first heartbeat grants the lease)
+        times = times.at[2 * c].set(bounded(rand[2 * c], 0, 50_000_000))
+        kinds = kinds.at[2 * c].set(K_KEEPALIVE)
+        pays = pays.at[2 * c].set(_pay(c))
+        times = times.at[2 * c + 1].set(
+            bounded(rand[2 * c + 1], cfg.op_lo_ns, cfg.op_hi_ns)
+        )
+        kinds = kinds.at[2 * c + 1].set(K_OP)
+        pays = pays.at[2 * c + 1].set(_pay(c))
+    base = 2 * nc
+    for p in range(cfg.partitions):
+        t_part = bounded(rand[base + 2 * p], 0, cfg.part_window_ns)
+        dur = bounded(rand[base + 2 * p + 1], cfg.part_lo_ns, cfg.part_hi_ns)
+        victim = bounded(rand[ninit + p], 0, nc).astype(jnp.int32)
+        times = times.at[base + 2 * p].set(t_part)
+        kinds = kinds.at[base + 2 * p].set(K_PART)
+        pays = pays.at[base + 2 * p].set(_pay(victim))
+        times = times.at[base + 2 * p + 1].set(t_part + dur)
+        kinds = kinds.at[base + 2 * p + 1].set(K_HEAL)
+        pays = pays.at[base + 2 * p + 1].set(_pay(victim))
+    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def workload(cfg: EtcdConfig = EtcdConfig()) -> Workload:
+    """Build the engine Workload for an etcd sweep configuration."""
+    return Workload(
+        init=partial(_init, cfg),
+        handle=partial(_handle, cfg),
+        num_rand=6,
+        payload_slots=PAYLOAD_SLOTS,
+        max_emits=2,
+    )
+
+
+def engine_config(cfg: EtcdConfig = EtcdConfig(), **overrides) -> EngineConfig:
+    """Engine parameters: steady state holds 2 timer chains + ≤1 request +
+    ≤1 reply per client, plus the expiry deadlines — every keepalive
+    schedules a fresh K_EXPIRE while stale generations stay queued until
+    their deadlines pass, so up to ``ceil(ttl / keepalive_lo) + 1``
+    coexist per lease — and the partition plan."""
+    stale_expiries = cfg.ttl_ns // cfg.keepalive_lo_ns + 1
+    defaults = dict(
+        queue_capacity=max(
+            48,
+            cfg.num_clients * (4 + stale_expiries) + 2 * cfg.partitions + 8,
+        ),
+        time_limit_ns=5_000_000_000,
+        max_steps=200_000,
+    )
+    defaults.update(overrides)
+    return EngineConfig(**defaults)
+
+
+def sweep_summary(final) -> dict:
+    """Host-side reduction of a finished sweep's batched EngineState."""
+    import numpy as np
+
+    w: EtcdState = final.wstate
+    return {
+        "seeds": int(final.seed.shape[0]),
+        "violations": int(np.sum(np.asarray(w.violation))),
+        "rev_regress_seeds": int(np.sum(np.asarray(w.vio_rev))),
+        "expiry_seeds": int(np.sum(np.asarray(w.vio_expiry))),
+        "puts": int(np.sum(np.asarray(w.puts))),
+        "gets": int(np.sum(np.asarray(w.gets))),
+        "keepalives": int(np.sum(np.asarray(w.keepalives))),
+        "grants": int(np.sum(np.asarray(w.grants))),
+        "expiries": int(np.sum(np.asarray(w.expiries))),
+        "keys_expired": int(np.sum(np.asarray(w.keys_expired))),
+        "partitions": int(np.sum(np.asarray(w.parts))),
+        "final_rev": int(np.sum(np.asarray(w.rev))),
+        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
+        "queue_high_water": int(np.max(np.asarray(final.qmax))),
+        "events_total": int(np.sum(np.asarray(final.ctr))),
+        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
+        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
+    }

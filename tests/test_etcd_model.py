@@ -1,0 +1,101 @@
+"""etcd device workload: healthy sweeps are quiet, partitions really expire
+leases, both injected bugs are caught, and traced CPU replay matches.
+
+BASELINE.md config #2: 3-node KV + lease with net-partition injection.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.models import etcd
+
+CFG = etcd.EtcdConfig()
+ECFG = etcd.engine_config(CFG, time_limit_ns=5_000_000_000, max_steps=40_000)
+
+
+def test_healthy_sweep_quiet_and_progresses():
+    final = ecore.run_sweep(etcd.workload(CFG), ECFG, jnp.arange(256, dtype=jnp.int64))
+    s = etcd.sweep_summary(final)
+    assert s["violations"] == 0, s
+    assert s["puts"] > 0 and s["gets"] > 0 and s["keepalives"] > 0
+    assert s["partitions"] > 0  # the fault plan fired
+    # partitions block keepalives long enough to expire leases somewhere
+    # in the batch (part_hi 2s > ttl 1s)
+    assert s["expiries"] > 0 and s["keys_expired"] > 0
+    assert s["overflow_seeds"] == 0
+    assert s["queue_high_water"] <= ECFG.queue_capacity
+
+
+def test_skip_expiry_bug_is_caught():
+    """bug_skip_expiry leaves expired-lease keys in the store; the GET-side
+    checker must catch a stale read at some seed, and the seed replays."""
+    cfg = CFG._replace(bug_skip_expiry=True)
+    final = ecore.run_sweep(
+        etcd.workload(cfg), etcd.engine_config(cfg, time_limit_ns=5_000_000_000,
+                                               max_steps=40_000),
+        jnp.arange(512, dtype=jnp.int64),
+    )
+    s = etcd.sweep_summary(final)
+    assert s["expiry_seeds"] > 0, f"checker failed to catch the bug: {s}"
+    bad = np.asarray(final.seed)[np.asarray(final.wstate.vio_expiry)]
+    seed = int(bad[0])
+    with jax.default_device(jax.devices("cpu")[0]):
+        replayed, _ = ecore.run_traced(
+            etcd.workload(cfg),
+            etcd.engine_config(cfg, time_limit_ns=5_000_000_000, max_steps=40_000),
+            seed,
+        )
+    assert bool(replayed.wstate.vio_expiry)
+
+
+def test_rev_regress_bug_is_caught():
+    """bug_rev_regress decrements the revision at expiry; the client-side
+    monotonicity checker must catch it."""
+    cfg = CFG._replace(bug_rev_regress=True)
+    final = ecore.run_sweep(
+        etcd.workload(cfg), etcd.engine_config(cfg, time_limit_ns=5_000_000_000,
+                                               max_steps=40_000),
+        jnp.arange(512, dtype=jnp.int64),
+    )
+    s = etcd.sweep_summary(final)
+    assert s["rev_regress_seeds"] > 0, f"checker failed to catch the bug: {s}"
+
+
+def test_lease_state_is_consistent_at_end():
+    final = ecore.run_sweep(etcd.workload(CFG), ECFG, jnp.arange(128, dtype=jnp.int64))
+    w = final.wstate
+    present = np.asarray(w.kv_present)  # [S, K]
+    kv_lease = np.asarray(w.kv_lease)  # [S, K]
+    lease_on = np.asarray(w.lease_on)  # [S, NC]
+    # every present key with an attached lease points at a live lease
+    # (expiry deletes attached keys; rejected PUTs never attach dead ones)
+    attached = present & (kv_lease >= 0)
+    s_idx, k_idx = np.nonzero(attached)
+    assert lease_on[s_idx, kv_lease[s_idx, k_idx]].all()
+    # revision accounting: the revision only grows
+    assert (np.asarray(w.rev) >= 0).all()
+    assert (np.asarray(w.seen_rev) <= np.asarray(w.rev)[:, None]).all()
+    # mod-revision accounting: every present key was written at a real
+    # revision no later than the current one
+    mod_rev = np.asarray(w.kv_mod_rev)
+    rev = np.asarray(w.rev)
+    p_s, p_k = np.nonzero(present)
+    assert (mod_rev[p_s, p_k] >= 1).all()
+    assert (mod_rev[p_s, p_k] <= rev[p_s]).all()
+    # partition refcounts all returned to zero (every window healed)
+    assert (np.asarray(w.part_cnt) == 0).all()
+
+
+def test_traced_replay_matches_sweep():
+    wl = etcd.workload(CFG)
+    seeds = jnp.arange(5, dtype=jnp.int64)
+    final = ecore.run_sweep(wl, ECFG, seeds)
+    for i in range(5):
+        single, _ = ecore.run_traced(wl, ECFG, int(seeds[i]))
+        assert int(single.ctr) == int(final.ctr[i])
+        assert int(single.now_ns) == int(final.now_ns[i])
+        assert int(single.wstate.rev) == int(final.wstate.rev[i])
+        assert int(single.wstate.expiries) == int(final.wstate.expiries[i])
+        assert bool(single.wstate.violation) == bool(final.wstate.violation[i])
