@@ -156,6 +156,58 @@ def run_seed(
     return stats
 
 
+async def _supervise_plan(stats: Dict, n: int, plan, sim_seconds: float) -> None:
+    """Supervisor that applies a *recorded* fault plan (from a device-tier
+    trace, madsim_tpu/replay.py) instead of drawing its own faults.
+
+    Mismatched actions are skipped to match the device tier's semantics
+    exactly: restarting a live node is a no-op there (models/raft.py
+    ``_on_restart`` gates on ``was_dead``), while the host
+    ``Handle.restart`` would kill-and-respawn it — an extra fault the
+    recorded schedule never contained."""
+    h = ms.current_handle()
+    nodes: List = [
+        h.create_node().name(f"raft-{i}").ip(_ip(i)).init(_node_init(i, n, stats)).build()
+        for i in range(n)
+    ]
+    dead = [False] * n
+    for t_ns, action, idx in plan:
+        dt = t_ns / 1e9 - ms.time.elapsed()
+        if dt > 0:
+            await ms.sleep(dt)
+        if action == "crash" and not dead[idx]:
+            h.kill(nodes[idx])
+            dead[idx] = True
+        elif action == "restart" and dead[idx]:
+            h.restart(nodes[idx])
+            dead[idx] = False
+    remaining = sim_seconds - ms.time.elapsed()
+    if remaining > 0:
+        await ms.sleep(remaining)
+
+
+def run_seed_with_plan(
+    seed: int, plan, n: int = 5, sim_seconds: float = 3.0
+) -> Dict:
+    """One simulation with kills/restarts at the recorded virtual times.
+
+    The cross-tier replay target: a device-found seed's fault schedule
+    re-applied to this ordinary async implementation, debugger-attachable.
+    The run always extends at least one second past the last planned
+    fault so the cluster gets a post-fault observation window even when
+    the plan outlives ``sim_seconds``.
+    """
+    stats: Dict = {"elections": [], "violations": 0, "msgs": 0}
+    end_s = sim_seconds
+    if plan:
+        end_s = max(end_s, max(t for t, _, _ in plan) / 1e9 + 1.0)
+    rt = ms.Runtime(seed=seed)
+    rt.block_on(_supervise_plan(stats, n, plan, end_s))
+    stats["seed"] = seed
+    stats["leaders_elected"] = len(stats["elections"])
+    return stats
+
+
 if __name__ == "__main__":
     seed = int(sys.argv[1]) if len(sys.argv) > 1 else 1
     out = run_seed(seed)

@@ -103,6 +103,12 @@ class RaftConfig(NamedTuple):
     # buggify is enabled); 0 disables
     buggify_q32: int = 0
     history: int = 16  # election-safety ring size
+    # model the host-tier example's amnesia bug: crash wipes DURABLE state
+    # too (term/voted/log), so a restarted node can re-vote in a term it
+    # already voted in — the election-safety checker catches the double
+    # vote. Used by the cross-tier replay pipeline (madsim_tpu/replay.py)
+    # to find device seeds whose fault schedule breaks host-tier user code.
+    volatile_state: bool = False
 
 
 class RaftState(NamedTuple):
@@ -470,6 +476,15 @@ def _on_crash(cfg: RaftConfig, w: RaftState, now, pay, rand):
         tgen=set1(w.tgen, node, get1(w.tgen, node) + 1),
         lepoch=set1(w.lepoch, node, get1(w.lepoch, node) + 1),
     )
+    if cfg.volatile_state:
+        # amnesia mode: the "durable" state dies with the process too
+        # (what host-tier code that keeps everything in memory does)
+        w2 = w2._replace(
+            term=set1(w2.term, node, 0),
+            voted=set1(w2.voted, node, -1),
+            log_len=set1(w2.log_len, node, 0),
+            log_term=set1(w2.log_term, node, jnp.zeros((cfg.log_cap,), jnp.int32)),
+        )
     return w2, _emits(cfg, _no_bcast(cfg), _DISABLED_EXTRA, _DISABLED_EXTRA)
 
 

@@ -1,0 +1,104 @@
+"""Cross-tier replay: device-found failure seeds → host-tier user code.
+
+The sweep→replay pipeline (SURVEY.md §7 stage 5 acceptance):
+
+1. a TPU sweep flags violation seeds (``violation_seeds``);
+2. ``engine.run_traced`` re-runs one seed on the CPU backend — the
+   integer-only engine makes the replay bit-exact, so the violation is
+   confirmed and the full event schedule is captured;
+3. ``extract_fault_plan`` lifts the *externally injected* schedule — the
+   crash/restart fault events the simulator decided — out of the trace;
+4. the plan drives a host-tier supervisor (e.g.
+   ``examples/raft_host.run_seed_with_plan``) that applies the same
+   kills/restarts at the same virtual times to ordinary async user code,
+   where a debugger, print statements, or tracing spans can attach.
+
+Step 4 is the semantic bridge the reference gets for free by running one
+engine for everything (``MADSIM_TEST_SEED=N`` reruns the same binary,
+runtime/mod.rs:205-210). Two engines can't share one RNG stream, so what
+transfers is the *fault environment*, not the exact interleaving: the
+host tier explores its own schedules under the recorded faults
+(``replay_on_host`` scans a few host seeds), and within-tier bit-exact
+reproduction stays the job of ``run_traced``.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+FaultEvent = Tuple[int, str, int]  # (time_ns, "crash" | "restart", node)
+
+
+def amnesia_raft_config():
+    """The canonical cross-tier demo configuration: a 3-node Raft cluster
+    whose crashes wipe durable state — matching ``examples/raft_host.py``
+    semantics, where a restart loses everything in memory — under an
+    aggressive fault plan so modest sweeps find double-vote violations.
+
+    Returns ``(RaftConfig, EngineConfig)``; shared by ``tests/test_replay``
+    and ``scripts/replay_seed.py`` so the two never drift apart.
+    """
+    from .models import raft
+
+    cfg = raft.RaftConfig(
+        num_nodes=3,
+        crashes=3,
+        commands=0,
+        volatile_state=True,
+        crash_window_ns=2_000_000_000,
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=300_000_000,
+    )
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    return cfg, ecfg
+
+
+def violation_seeds(final) -> np.ndarray:
+    """Seeds whose workload latched ``violation`` in a finished sweep."""
+    return np.asarray(final.seed)[np.asarray(final.wstate.violation)]
+
+
+def extract_fault_plan(
+    trace: Dict, crash_kind: int, restart_kind: int, node_slot: int = 0
+) -> List[FaultEvent]:
+    """Lift the fired crash/restart events out of a ``run_traced`` trace.
+
+    ``trace`` is the dict returned by ``engine.run_traced``; ``crash_kind``
+    / ``restart_kind`` are the workload's event-kind codes (e.g.
+    ``models.raft.K_CRASH``); the victim node id sits in payload slot
+    ``node_slot``. Returns ``(time_ns, action, node)`` in dispatch order.
+    """
+    t = np.asarray(trace["time_ns"])
+    k = np.asarray(trace["kind"])
+    p = np.asarray(trace["pay"])
+    fired = np.asarray(trace["fired"])
+    plan: List[FaultEvent] = []
+    for i in np.nonzero(fired)[0]:
+        if k[i] == crash_kind:
+            plan.append((int(t[i]), "crash", int(p[i, node_slot])))
+        elif k[i] == restart_kind:
+            plan.append((int(t[i]), "restart", int(p[i, node_slot])))
+    return plan
+
+
+def replay_on_host(
+    run_with_plan: Callable[[int, Sequence[FaultEvent]], Dict],
+    plan: Sequence[FaultEvent],
+    host_seeds: Sequence[int] = range(8),
+    reproduced: Callable[[Dict], bool] = lambda r: r.get("violations", 0) > 0,
+) -> Optional[Dict]:
+    """Drive host-tier user code under the recorded fault plan.
+
+    ``run_with_plan(seed, plan)`` runs one host simulation (e.g.
+    ``examples/raft_host.run_seed_with_plan``); the host tier's own
+    schedule randomization varies per seed, so a few seeds are scanned.
+    Returns the first result where ``reproduced`` holds, else None.
+    """
+    for seed in host_seeds:
+        result = run_with_plan(int(seed), plan)
+        if reproduced(result):
+            result["host_seed"] = int(seed)
+            return result
+    return None

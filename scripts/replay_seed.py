@@ -1,0 +1,102 @@
+"""Replay a device-found failure seed: CPU trace + host-tier reproduction.
+
+Usage:
+    python scripts/replay_seed.py SEED [--host-seeds N] [--volatile]
+
+Runs the flagship Raft sweep config for one seed on the CPU backend with
+full event tracing (bit-exact vs the TPU sweep), prints the dispatched
+event log and the extracted fault plan, then replays the plan against the
+host-tier example (examples/raft_host.py) scanning N host seeds for a
+reproduction — the workflow a user follows when a TPU sweep reports a
+violation seed (the analogue of the reference's "run with
+MADSIM_TEST_SEED={seed} to reproduce", runtime/mod.rs:205-210; attach pdb
+inside raft_host handlers to step through the reproduction).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+sys.path.insert(0, os.path.join(_repo, "examples"))
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("seed", type=int)
+    ap.add_argument("--host-seeds", type=int, default=10)
+    ap.add_argument(
+        "--volatile", action="store_true",
+        help="amnesia config (crash wipes durable state — the host example's semantics)",
+    )
+    ap.add_argument("--events", type=int, default=30, help="trace lines to print")
+    args = ap.parse_args()
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    import numpy as np
+
+    import raft_host
+    from madsim_tpu import replay
+    from madsim_tpu.engine import core
+    from madsim_tpu.models import raft
+
+    if args.volatile:
+        cfg, ecfg = replay.amnesia_raft_config()
+    else:
+        cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+        ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+
+    # event-kind names from the model's own constants (never drifts)
+    kind_names = {
+        getattr(raft, name): name[2:] for name in dir(raft) if name.startswith("K_")
+    }
+
+    final, trace = core.run_traced(raft.workload(cfg), ecfg, args.seed)
+    w = final.wstate
+    print(
+        f"seed={args.seed} events={int(final.ctr)} "
+        f"sim_time={int(final.now_ns) / 1e9:.3f}s "
+        f"elections={int(w.elections)} violation={bool(w.violation)}"
+    )
+
+    fired = np.asarray(trace["fired"])
+    times = np.asarray(trace["time_ns"])
+    kinds = np.asarray(trace["kind"])
+    pays = np.asarray(trace["pay"])
+    idx = np.nonzero(fired)[0]
+    print(f"--- first {min(args.events, idx.size)} of {idx.size} dispatched events ---")
+    for i in idx[: args.events]:
+        name = kind_names.get(int(kinds[i]), str(int(kinds[i])))
+        print(f"  t={times[i] / 1e9:9.6f}s {name:<9} pay={[int(x) for x in pays[i][:4]]}")
+
+    plan = replay.extract_fault_plan(trace, raft.K_CRASH, raft.K_RESTART)
+    print(f"--- fault plan ({len(plan)} events) ---")
+    for t, action, node in plan:
+        print(f"  t={t / 1e9:9.6f}s {action:<7} node={node}")
+
+    if not plan:
+        print("no faults in this seed's schedule; nothing to replay on host")
+        return
+    print(f"--- host-tier replay (scanning {args.host_seeds} host seeds) ---")
+    result = replay.replay_on_host(
+        lambda hs, p: raft_host.run_seed_with_plan(
+            hs, p, n=cfg.num_nodes, sim_seconds=3.0
+        ),
+        plan,
+        host_seeds=range(args.host_seeds),
+    )
+    if result is None:
+        print("no host-tier reproduction in the scanned seeds "
+              "(within-tier CPU trace above is the bit-exact artifact)")
+    else:
+        print(
+            f"REPRODUCED on host_seed={result['host_seed']}: "
+            f"violations={result['violations']} "
+            f"elections={result['leaders_elected']} msgs={result['msgs']}"
+        )
+
+
+if __name__ == "__main__":
+    main()
