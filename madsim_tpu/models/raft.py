@@ -88,6 +88,10 @@ class RaftConfig(NamedTuple):
     commands: int = 8
     cmd_window_ns: int = 4_000_000_000
     cmd_retry_ns: int = 50_000_000
+    # a command that can't find a leader stops retrying after this many
+    # attempts (surfaced as cmd_giveups) instead of spinning K_CMD events
+    # until the time limit in partitioned seeds
+    cmd_max_retries: int = 64
     log_cap: int = 32
     # fault plan: `crashes` node-crash events at random times in the first
     # `crash_window_ns`, each restarting after a random delay
@@ -143,6 +147,7 @@ class RaftState(NamedTuple):
     elections: jnp.ndarray  # int32
     commits: jnp.ndarray  # int32 (total commit-index advancement)
     accepted_cmds: jnp.ndarray  # int32
+    cmd_giveups: jnp.ndarray  # int32 commands that hit the retry cap
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
 
@@ -522,10 +527,17 @@ def _on_cmd(cfg: RaftConfig, w: RaftState, now, pay, rand):
         accepted_cmds=w.accepted_cmds + jnp.where(accept, 1, 0),
     )
     next_target = (target + 1) % cfg.num_nodes
+    give_up = ~accept & (retries + 1 >= cfg.cmd_max_retries)
+    w2 = w2._replace(cmd_giveups=w2.cmd_giveups + jnp.where(give_up, 1, 0))
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (now + cfg.cmd_retry_ns, K_CMD, _pay(next_target, retries + 1), ~accept),
+        (
+            now + cfg.cmd_retry_ns,
+            K_CMD,
+            _pay(next_target, retries + 1),
+            ~accept & ~give_up,
+        ),
         _DISABLED_EXTRA,
     )
     return w2, emits
@@ -581,6 +593,7 @@ def _init(cfg: RaftConfig, key):
         elections=jnp.zeros((), jnp.int32),
         commits=jnp.zeros((), jnp.int32),
         accepted_cmds=jnp.zeros((), jnp.int32),
+        cmd_giveups=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
     )
@@ -658,6 +671,7 @@ def sweep_summary(final) -> dict:
         "no_leader_seeds": int(np.sum(np.asarray(w.elections) == 0)),
         "commits_total": int(np.sum(np.asarray(w.commits))),
         "accepted_cmds": int(np.sum(np.asarray(w.accepted_cmds))),
+        "cmd_giveups": int(np.sum(np.asarray(w.cmd_giveups))),
         "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
         "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
         "queue_high_water": int(np.max(np.asarray(final.qmax))),

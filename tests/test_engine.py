@@ -330,3 +330,75 @@ def test_same_timestamp_events_interleave_across_seeds():
     assert set(first.tolist()) == {1, 2}, (
         "across seeds both orders of the tied pair must occur"
     )
+
+
+# -- queue-capacity bound (exact boundary) ----------------------------------
+
+
+def _spawner_workload():
+    """Synthetic growth workload: every handled event spawns two future
+    events, so queue occupancy grows by exactly one per step — a ruler for
+    the capacity boundary."""
+    from madsim_tpu.engine.core import Emits, Workload
+
+    def init(key):
+        emits = Emits(
+            times=jnp.array([100, 0], jnp.int64),
+            kinds=jnp.zeros((2,), jnp.int32),
+            pays=jnp.zeros((2, 1), jnp.int32),
+            enables=jnp.array([True, False]),
+        )
+        return jnp.zeros(()), emits
+
+    def handle(w, now, kind, pay, rand):
+        emits = Emits(
+            times=jnp.stack([now + 100, now + 200]),
+            kinds=jnp.zeros((2,), jnp.int32),
+            pays=jnp.zeros((2, 1), jnp.int32),
+            enables=jnp.ones((2,), bool),
+        )
+        return w, emits
+
+    return Workload(init=init, handle=handle, num_rand=1, payload_slots=1, max_emits=2)
+
+
+def test_queue_fills_to_exact_capacity_without_overflow():
+    """Occupancy can reach exactly queue_capacity with the overflow flag
+    still clear: the bound is tight, not conservative."""
+    cap = 8
+    wl = _spawner_workload()
+    cfg = EngineConfig(queue_capacity=cap, time_limit_ns=1 << 40,
+                       max_steps=cap - 1, cond_interval=1)
+    final = ecore.run_sweep(wl, cfg, jnp.arange(4, dtype=jnp.int64))
+    assert (np.asarray(final.qmax) == cap).all()
+    assert not np.asarray(final.overflow).any()
+
+
+def test_queue_overflow_latches_exactly_past_capacity():
+    """One step beyond the fill point the push exceeds capacity and the
+    sticky overflow flag latches — at capacity+1 demand, not before."""
+    cap = 8
+    wl = _spawner_workload()
+    cfg = EngineConfig(queue_capacity=cap, time_limit_ns=1 << 40,
+                       max_steps=cap, cond_interval=1)
+    final = ecore.run_sweep(wl, cfg, jnp.arange(4, dtype=jnp.int64))
+    assert (np.asarray(final.qmax) == cap).all()  # never exceeds capacity
+    assert np.asarray(final.overflow).all()
+
+
+# -- raft client-command retry cap ------------------------------------------
+
+
+def test_cmd_retry_cap_and_giveups_surfaced():
+    """With a fully lossy network no leader ever emerges: every command
+    retries to the cap, gives up (bounded K_CMD chains — no spinning until
+    the time limit), and the give-ups are surfaced in the summary."""
+    cfg = raft.RaftConfig(
+        num_nodes=3, crashes=0, commands=4, loss_q32=prob_to_q32(1.0),
+        cmd_max_retries=5, cmd_retry_ns=10_000_000,
+    )
+    ecfg = raft.engine_config(cfg, time_limit_ns=2_000_000_000, max_steps=50_000)
+    final = ecore.run_sweep(raft.workload(cfg), ecfg, jnp.arange(8, dtype=jnp.int64))
+    s = raft.sweep_summary(final)
+    assert s["accepted_cmds"] == 0
+    assert s["cmd_giveups"] == 8 * cfg.commands  # every command capped out
