@@ -29,10 +29,21 @@ def hash_str(s: str) -> int:
     return int.from_bytes(hashlib.sha256(s.encode()).digest()[:8], "little")
 
 
+_REQUEST_TYPES: dict = {}
+
+
+def request_types() -> dict:
+    """Live registry of every defined Request subclass, keyed by qualified
+    name — the set of user types the real-mode codec may materialize
+    (real/codec.py). Never triggers an import."""
+    return _REQUEST_TYPES
+
+
 class Request:
     """Base class for RPC request types (``#[derive(Request)]`` analogue).
 
-    Subclassing assigns a stable ``RPC_ID`` from the qualified class name.
+    Subclassing assigns a stable ``RPC_ID`` from the qualified class name
+    and registers the type for the real-mode wire codec.
     Set class attr ``Response`` for documentation purposes (untyped here).
     """
 
@@ -42,6 +53,7 @@ class Request:
     def __init_subclass__(cls, **kwargs: Any) -> None:
         super().__init_subclass__(**kwargs)
         cls.RPC_ID = hash_str(f"{cls.__module__}::{cls.__qualname__}")
+        _REQUEST_TYPES[f"{cls.__module__}::{cls.__qualname__}"] = cls
 
 
 def request_id(req: Any) -> int:

@@ -12,19 +12,25 @@ against madsim_tpu runs unmodified against a real network:
     rt.block_on(main())
 
 Provided: ``Runtime.block_on``, ``spawn``, ``sleep``/``timeout``/
-``interval``/``Instant``, tag-matching ``Endpoint`` over real UDP
-datagrams, and the built-in RPC (``call`` / ``add_rpc_handler``) speaking
-pickled frames. Randomness is real randomness; there is no determinism in
-real mode (matching the reference, where buggify is a no-op and seeds
-don't exist, std/buggify.rs:6-30).
+``interval``/``Instant``, tag-matching ``Endpoint`` (UDP datagrams) and
+``TcpEndpoint`` (length-delimited frames over persistent connections, the
+reference std transport's shape), and the built-in RPC (``call`` /
+``add_rpc_handler``) on either. Frames use the restricted binary codec
+(real/codec.py) — never pickle, so a hostile peer cannot execute code.
+Randomness is real randomness; there is no determinism in real mode
+(matching the reference, where buggify is a no-op and seeds don't exist,
+std/buggify.rs:6-30).
 """
 
 from .runtime import Runtime, spawn
 from .time import Instant, interval, now_instant, sleep, timeout
-from .net import Endpoint
+from .net import Endpoint, TcpEndpoint
+from . import codec
 
 __all__ = [
     "Endpoint",
+    "TcpEndpoint",
+    "codec",
     "Instant",
     "Runtime",
     "interval",

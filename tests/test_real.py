@@ -96,3 +96,280 @@ def test_real_spawn_and_abort():
         assert len(hits) == n and n >= 2
 
     rt.block_on(main())
+
+
+# -- framed TCP transport (reference std/net/tcp.rs parity) ------------------
+
+
+def test_tcp_endpoint_tag_matching_loopback():
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        addr = server.local_addr()
+
+        async def serve():
+            data, src = await server.recv_from(7)
+            assert data == b"ping"
+            await server.send_to(src, 8, b"pong")
+
+        t = real.spawn(serve())
+        await client.send_to(addr, 7, b"ping")
+        data, _src = await client.recv_from(8)
+        assert data == b"pong"
+        await t
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_tcp_rpc_concurrent_clients():
+    rt = real.Runtime()
+
+    async def main():
+        import asyncio
+
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+
+        async def handler(req: Ping) -> int:
+            await real.sleep(0.005)  # overlap the in-flight requests
+            return req.value * 3
+
+        server.add_rpc_handler(Ping, handler)
+        clients = [await real.TcpEndpoint.bind(("127.0.0.1", 0)) for _ in range(5)]
+
+        async def one(i, c):
+            return await c.call(server.local_addr(), Ping(i))
+
+        results = await asyncio.gather(
+            *(one(i, c) for i, c in enumerate(clients) for _ in range(3))
+        )
+        assert results == [i * 3 for i in range(5) for _ in range(3)]
+        for c in clients:
+            c.close()
+        server.close()
+
+    rt.block_on(main())
+
+
+def test_tcp_large_payload_beyond_udp_limit():
+    """Length-delimited framing has no datagram size cliff: 1 MiB payloads
+    round-trip (UDP tops out near 64 KiB)."""
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        blob = bytes(range(256)) * 4096  # 1 MiB
+
+        async def serve():
+            data, src = await server.recv_from(1)
+            await server.send_to(src, 2, data[::-1])
+
+        t = real.spawn(serve())
+        await client.send_to(server.local_addr(), 1, blob)
+        data, _ = await client.recv_from(2)
+        assert data == blob[::-1]
+        await t
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_tcp_reconnect_after_server_restart():
+    """A cached connection that dies is evicted and redialed: the client
+    keeps working across a server endpoint restart on the same port."""
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        addr = server.local_addr()
+
+        async def echo(ep):
+            while True:
+                data, src = await ep.recv_from(5)
+                await ep.send_to(src, 6, data)
+
+        t1 = real.spawn(echo(server))
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        await client.send_to(addr, 5, b"one")
+        data, _ = await client.recv_from(6)
+        assert data == b"one"
+
+        t1.abort()
+        server.close()
+        await real.sleep(0.1)  # client reader sees EOF, evicts the conn
+
+        server2 = await real.TcpEndpoint.bind(addr)
+        t2 = real.spawn(echo(server2))
+        await client.send_to(addr, 5, b"two")
+        data, _ = await client.recv_from(6)
+        assert data == b"two"
+        t2.abort()
+        server2.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+# -- restricted codec (the pickle-RCE fix) -----------------------------------
+
+
+def test_codec_roundtrip_structures():
+    from madsim_tpu.real import codec
+
+    cases = [
+        None, True, False, 0, -1, 2**64 - 1, -(2**70), 3.5, "héllo", b"\x00\xff",
+        (1, "a", b"b"), [1, [2, [3]]], {"k": (1, 2), 5: None},
+        (2**63, Ping(7), b""),
+    ]
+    for obj in cases:
+        out = codec.loads(codec.dumps(obj))
+        if isinstance(obj, Ping):
+            assert isinstance(out, Ping) and out.value == obj.value
+        elif isinstance(obj, tuple) and any(isinstance(x, Ping) for x in obj):
+            assert out[0] == obj[0] and out[1].value == obj[1].value
+        else:
+            assert out == obj and type(out) is type(obj)
+
+
+def test_codec_refuses_unregistered_types():
+    """The security property: a frame naming a class that is not a
+    registered Request cannot materialize it (no import, no code run)."""
+    import struct as _struct
+
+    from madsim_tpu.real import codec
+
+    class NotRegistered:
+        pass
+
+    with pytest.raises(codec.CodecError):
+        codec.dumps(NotRegistered())
+
+    # hand-craft a hostile frame claiming to be os.system-adjacent
+    name = b"os::system"
+    frame = b"O" + _struct.pack(">I", len(name)) + name + b"d" + _struct.pack(">I", 0)
+    with pytest.raises(codec.CodecError):
+        codec.loads(frame)
+
+    # truncated and trailing-garbage frames are rejected, not crashes
+    good = codec.dumps((1, b"x"))
+    with pytest.raises(codec.CodecError):
+        codec.loads(good[:-1])
+    with pytest.raises(codec.CodecError):
+        codec.loads(good + b"Z")
+
+
+def test_udp_endpoint_drops_hostile_frames():
+    """A malformed/hostile datagram is dropped like line noise; the
+    endpoint keeps serving."""
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.Endpoint.bind(("127.0.0.1", 0))
+        client = await real.Endpoint.bind(("127.0.0.1", 0))
+        import socket as _socket
+
+        raw = _socket.socket(_socket.AF_INET, _socket.SOCK_DGRAM)
+        raw.sendto(b"\x80\x04pickle-bomb", server.local_addr())
+        raw.sendto(b"O\x00\x00\x00\x09os::evil" + b"d\x00\x00\x00\x00", server.local_addr())
+        raw.close()
+
+        async def serve():
+            data, src = await server.recv_from(9)
+            await server.send_to(src, 10, data)
+
+        t = real.spawn(serve())
+        await client.send_to(server.local_addr(), 9, b"still-alive")
+        data, _ = await client.recv_from(10)
+        assert data == b"still-alive"
+        await t
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_rpc_unencodable_response_fails_caller_loudly():
+    """A handler returning an unregistered class must raise RpcError at
+    the caller, not hang it forever on a response that can never arrive."""
+    from madsim_tpu.real.net import RpcError
+
+    class Opaque:  # not a Request, not registered
+        pass
+
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+
+        async def handler(req: Ping):
+            return Opaque()
+
+        server.add_rpc_handler(Ping, handler)
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        with pytest.raises(RpcError):
+            await real.timeout(2.0, client.call(server.local_addr(), Ping(1)))
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_tcp_hello_claimed_host_is_ignored():
+    """Connection keys use the TCP-observed peer IP: a hello claiming
+    another node's host cannot capture that node's traffic, and replies
+    still reach the real dialer."""
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+
+        async def serve():
+            data, src = await server.recv_from(3)
+            # src host is the observed 127.0.0.1, never the claimed one
+            assert src[0] == "127.0.0.1"
+            await server.send_to(src, 4, b"ack")
+
+        t = real.spawn(serve())
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        client._local = ("10.99.99.99", client._local[1])  # lie about host
+        await client.send_to(server.local_addr(), 3, b"hi")
+        data, _ = await client.recv_from(4)
+        assert data == b"ack"
+        await t
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_tcp_oversized_frame_fails_at_sender():
+    rt = real.Runtime()
+
+    async def main():
+        server = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        client = await real.TcpEndpoint.bind(("127.0.0.1", 0))
+        with pytest.raises(ValueError):
+            await client.send_to(server.local_addr(), 1, bytes(70 * 1024 * 1024))
+        server.close()
+        client.close()
+
+    rt.block_on(main())
+
+
+def test_codec_hostile_bytes_always_raise_codec_error():
+    from madsim_tpu.real import codec
+
+    hostile = [
+        b"s\x00\x00\x00\x01\xff",  # invalid UTF-8 string
+        b"d\x00\x00\x00\x01l\x00\x00\x00\x00N",  # unhashable dict key
+        b"",
+        b"\x99",
+    ]
+    for frame in hostile:
+        with pytest.raises(codec.CodecError):
+            codec.loads(frame)
