@@ -59,8 +59,6 @@ def resume_sweep(
     workload: Workload, cfg: EngineConfig, state: EngineState
 ) -> EngineState:
     """Continue a (possibly restored) sweep until every seed finishes."""
-    from functools import partial
+    from .core import _drive
 
-    from .core import drive
-
-    return partial(jax.jit, static_argnums=(0, 1))(drive)(workload, cfg, state)
+    return _drive(workload, cfg, state)  # shares run_sweep's trace cache

@@ -15,7 +15,7 @@ resharding traffic to place.
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import Optional, Sequence
 
 import jax
@@ -43,13 +43,18 @@ def shard_seeds(mesh: Mesh, seeds: jnp.ndarray) -> jnp.ndarray:
 
 
 def sharded_step(workload: Workload, cfg: EngineConfig, mesh: Mesh):
-    """Build the per-iteration sharded step: advances every local seed one
-    event and returns the global number of still-live seeds via ``psum``."""
+    """Build an explicit n-step sharded step: advances every local seed
+    ``n_steps`` events and returns the global number of still-live seeds
+    via ``psum``.
+
+    Kept as the multichip dryrun/CI entry point (__graft_entry__ calls it
+    with a fixed n_steps to demonstrate one sharded step + collective);
+    the production sweep path is ``run_sweep_sharded``, whose flat
+    per-device loop avoids the ~9x nested-device-loop penalty this
+    chunked shape pays on TPU."""
 
     def local_step(state: EngineState, n_steps):
-        # up to cond_interval engine steps per invocation (finished seeds
-        # are frozen no-ops; the caller clamps n_steps so the max_steps
-        # budget is exact) — the cross-device psum amortizes over the chunk
+        # finished seeds are frozen no-ops, so over-stepping is harmless
         state = jax.lax.fori_loop(
             0,
             n_steps,
@@ -72,33 +77,57 @@ def sharded_step(workload: Workload, cfg: EngineConfig, mesh: Mesh):
     )
 
 
+@lru_cache(maxsize=64)
+def _sharded_run(workload: Workload, cfg: EngineConfig, mesh: Mesh):
+    """Cached jitted whole-sweep program for (workload, cfg, mesh) — a
+    fresh wrapper per call would retrace and recompile every invocation."""
+
+    def device_run(state: EngineState) -> EngineState:
+        def cond(carry):
+            state, iters = carry
+            live = jax.lax.psum(
+                jnp.sum(~state.done, dtype=jnp.int32), SEED_AXIS
+            )
+            return (live > 0) & (iters < cfg.max_steps)
+
+        def body(carry):
+            state, iters = carry
+            return jax.vmap(partial(step_one, workload, cfg))(state), iters + 1
+
+        state, _ = jax.lax.while_loop(
+            cond, body, (state, jnp.zeros((), jnp.int64))
+        )
+        return state
+
+    return jax.jit(
+        jax.shard_map(
+            device_run,
+            mesh=mesh,
+            in_specs=P(SEED_AXIS),
+            out_specs=P(SEED_AXIS),
+            check_vma=False,  # same rationale as sharded_step
+        )
+    )
+
+
 def run_sweep_sharded(
     workload: Workload, cfg: EngineConfig, seeds, mesh: Optional[Mesh] = None
 ) -> EngineState:
     """Run a seed sweep sharded over a device mesh; bit-identical to the
-    single-device ``engine.run_sweep`` for the same seeds."""
+    single-device ``engine.run_sweep`` for the same seeds.
+
+    The whole sweep loop lives INSIDE ``shard_map`` — one flat per-device
+    ``while_loop`` whose cond psums the live count every step, so all
+    devices terminate together. Flat because a nested device loop costs
+    ~9x per step on TPU (engine/core.py ``drive``); the per-step psum
+    rides ICI and is noise next to a step."""
     if mesh is None:
         mesh = seed_mesh()
     seeds = shard_seeds(mesh, seeds)
-    step = sharded_step(workload, cfg, mesh)
+    # init and loop compile as separate programs (same split as
+    # engine.core._run: fusing the init writes pessimizes the loop carry);
+    # core._init shares run_sweep's trace cache
+    from ..engine.core import _init
 
-    @partial(jax.jit, static_argnums=())
-    def run(seeds):
-        state = init_sweep(workload, cfg, seeds)
-
-        def cond(carry):
-            _, live, iters = carry
-            return (live > 0) & (iters < cfg.max_steps)
-
-        def body(carry):
-            state, _, iters = carry
-            n = jnp.minimum(cfg.cond_interval, cfg.max_steps - iters)
-            state, live = step(state, n)
-            return state, live, iters + n
-
-        state, _, _ = jax.lax.while_loop(
-            cond, body, (state, jnp.int32(seeds.shape[0]), jnp.zeros((), jnp.int64))
-        )
-        return state
-
-    return run(seeds)
+    state = _init(workload, cfg, seeds)
+    return _sharded_run(workload, cfg, mesh)(state)
