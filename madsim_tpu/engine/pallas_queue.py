@@ -1,0 +1,153 @@
+"""Pallas TPU kernel for the batched pop-min (random tie-break) phase.
+
+SURVEY.md §7 stage 5 reserves Pallas for the event-queue inner loop if the
+jit path bottlenecks. Round-3 profiling (see docs/pallas_finding.md)
+showed the real 10x levers were loop structure, not op kernels — this
+module exists to *prove* the remaining headroom claim with a measured
+A/B rather than assert it: ``scripts/bench_pallas.py`` races this kernel
+against the XLA path that ``engine.queue.pop_min`` compiles to, asserting
+bit-identical pop decisions.
+
+Kernel design notes (TPU constraints):
+- TPU vector units have no int64 lanes, so the int64 deadline array is
+  split into (hi, lo) int32 planes and the min is lexicographic; unsigned
+  order for the lo half (and for the tie-break priorities) is recovered
+  by XOR-ing the sign bit before signed compares.
+- The whole [block, Q] tile lives in VMEM; min/tie-break/index-select are
+  a handful of VPU reductions. Q is lane-padded to 128 with INVALID
+  deadlines, seed blocks ride the sublane axis.
+- The tie-break priority hash is bit-identical to ``queue.pop_min``
+  (same murmur3 finalizer over slot iota XOR draw), and the
+  winner-selection order (min priority, then min slot index among
+  candidates) matches XLA ``argmin`` semantics exactly — the kernel can
+  substitute without breaking replay parity.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from .queue import _HASH_MULT, INVALID_TIME, EventQueue
+
+_LANE = 128
+_BLOCK = 128  # seeds per grid step
+
+# python ints (a jnp scalar would be captured as a traced kernel constant)
+_INV_HI = int(INVALID_TIME) >> 32  # 0x7fffffff
+_SIGN = 0x80000000
+_INV_LO_BIASED = (0xFFFFFFFF ^ _SIGN) - (1 << 32)  # as signed int32 (-1^sign)
+
+
+def _murmur_prio(iota_u32, tie_u32):
+    """The queue.pop_min priority hash, verbatim (uint32 ops)."""
+    x = iota_u32 * _HASH_MULT ^ tie_u32
+    x ^= x >> 16
+    x *= 0x85EBCA6B
+    x ^= x >> 13
+    x *= 0xC2B2AE35
+    return x ^ (x >> 16)
+
+
+def _kernel(thi_ref, tlo_ref, tie_ref, slot_ref, found_ref):
+    thi = thi_ref[:]  # int32[B, Qp]
+    tlo = tlo_ref[:]  # int32[B, Qp], sign-biased unsigned lo half
+    tie = tie_ref[:]  # int32[B, 1] raw tie draw bits
+
+    # lexicographic min over slots: min hi, then min (unsigned) lo there
+    mh = jnp.min(thi, axis=1, keepdims=True)
+    c1 = thi == mh
+    ml = jnp.min(
+        jnp.where(c1, tlo, jnp.int32(0x7FFFFFFF)), axis=1, keepdims=True
+    )
+    cand = c1 & (tlo == ml)
+
+    # random tie-break: minimal murmur priority among candidates, then
+    # minimal slot index — exactly argmin(where(cand, prio, BIG)) order
+    q_iota = jax.lax.broadcasted_iota(jnp.uint32, thi.shape, 1)
+    prio = _murmur_prio(q_iota, tie.astype(jnp.uint32))
+    pb = (prio ^ _SIGN).astype(jnp.int32)  # unsigned order, signed compare
+    mp = jnp.min(
+        jnp.where(cand, pb, jnp.int32(0x7FFFFFFF)), axis=1, keepdims=True
+    )
+    winner = cand & (pb == mp)
+    qp = thi.shape[1]
+    idx = jax.lax.broadcasted_iota(jnp.int32, thi.shape, 1)
+    slot = jnp.min(jnp.where(winner, idx, jnp.int32(qp)), axis=1)
+
+    found = ~((mh[:, 0] == _INV_HI) & (ml[:, 0] == _INV_LO_BIASED))
+    slot_ref[:, 0] = slot
+    found_ref[:, 0] = found.astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("interpret",))
+def pop_min_pallas(q: EventQueue, tie_u32: jnp.ndarray, interpret: bool = False):
+    """Batched pop decision via the Pallas kernel.
+
+    ``q`` holds a LEADING seed axis on every leaf ([S, Q] / [S, Q, P]);
+    ``tie_u32`` is uint32[S]. Returns ``(slot int32[S], found bool[S])``
+    — bit-identical to what ``vmap(queue.pop_min)`` selects. Boundary
+    costs (int64 split, lane padding) are inside this function on
+    purpose: any honest A/B must pay them.
+    """
+    from jax.experimental import pallas as pl
+
+    t = q.time  # int64[S, Q]
+    s, qn = t.shape
+    qp = -(-qn // _LANE) * _LANE
+    thi = (t >> 32).astype(jnp.int32)
+    tlo_u = (t & 0xFFFFFFFF).astype(jnp.uint32)
+    tlo = (tlo_u ^ jnp.uint32(_SIGN)).astype(jnp.int32)
+    if qp != qn:
+        pad_hi = jnp.full((s, qp - qn), _INV_HI, jnp.int32)
+        pad_lo = jnp.full((s, qp - qn), _INV_LO_BIASED, jnp.int32)
+        thi = jnp.concatenate([thi, pad_hi], axis=1)
+        tlo = jnp.concatenate([tlo, pad_lo], axis=1)
+    tie = tie_u32.astype(jnp.uint32).astype(jnp.int32).reshape(s, 1)
+
+    # index maps return an int32 zero explicitly: under jax_enable_x64
+    # (which this engine forces) a literal 0 promotes to i64 and Mosaic
+    # rejects the mixed (i32, i64) index tuple
+    row = lambda i: (i, jnp.int32(0))  # noqa: E731
+    grid = (s // _BLOCK,) if s % _BLOCK == 0 else (-(-s // _BLOCK),)
+    slot, found = pl.pallas_call(
+        _kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((_BLOCK, qp), row),
+            pl.BlockSpec((_BLOCK, qp), row),
+            pl.BlockSpec((_BLOCK, 1), row),
+        ],
+        out_specs=[
+            pl.BlockSpec((_BLOCK, 1), row),
+            pl.BlockSpec((_BLOCK, 1), row),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((s, 1), jnp.int32),
+            jax.ShapeDtypeStruct((s, 1), jnp.int32),
+        ],
+        interpret=interpret,
+    )(thi, tlo, tie)
+    return slot[:, 0], found[:, 0].astype(bool)
+
+
+@jax.jit
+def pop_min_xla(q: EventQueue, tie_u32: jnp.ndarray):
+    """The production path's pop decision, reduced to (slot, found) for
+    the A/B: same math ``queue.pop_min`` runs inside the fused step."""
+    from .queue import pop_min
+
+    def one(qi, tie):
+        _, t, _, _, found = pop_min(qi, tie_u32=tie)
+        # recover the chosen slot the same way pop_min's mask does
+        iota = jnp.arange(qi.time.shape[0], dtype=jnp.uint32)
+        prio = _murmur_prio(iota, jnp.asarray(tie, jnp.uint32))
+        cand = qi.time == jnp.min(qi.time)
+        slot = jnp.argmin(
+            jnp.where(cand, prio.astype(jnp.int64), jnp.int64(1) << 33)
+        ).astype(jnp.int32)
+        return slot, found
+
+    return jax.vmap(one)(q, tie_u32)

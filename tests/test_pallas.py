@@ -1,0 +1,50 @@
+"""Pallas pop-min kernel: bit-exact parity with the XLA path.
+
+The kernel (engine/pallas_queue.py) exists as measured evidence that the
+XLA path saturates the queue ops (docs/pallas_finding.md); parity is the
+property that makes the A/B meaningful — and would let it substitute
+without breaking replay. CI runs it in interpret mode (no TPU); the
+compiled path is exercised by scripts/bench_pallas.py on hardware.
+"""
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+from madsim_tpu.engine import core, pallas_queue as pq
+from madsim_tpu.models import raft
+
+
+def _queue_batch(n_seeds, steps=12):
+    cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+    ecfg = raft.engine_config(cfg)
+    wl = raft.workload(cfg)
+    state = jax.jit(partial(core.init_sweep, wl, ecfg))(
+        jnp.arange(n_seeds, dtype=jnp.int64)
+    )
+    step = jax.jit(partial(core.step_batch, wl, ecfg))
+    for _ in range(steps):
+        state = step(state)
+    return state.queue
+
+
+def test_pallas_pop_min_matches_xla_bit_exactly():
+    q = _queue_batch(256)
+    tie = jax.random.bits(jax.random.key(3), (256,), dtype=jnp.uint32)
+    sx, fx = pq.pop_min_xla(q, tie)
+    sp, fp = pq.pop_min_pallas(q, tie, interpret=True)
+    assert jnp.array_equal(sx, sp)
+    assert jnp.array_equal(fx, fp)
+    assert bool(fx.all())  # queues had content — the test is not vacuous
+
+
+def test_pallas_pop_min_empty_queues_report_not_found():
+    from madsim_tpu.engine import queue as equeue
+
+    empty = jax.vmap(lambda _: equeue.make(58, 8))(jnp.arange(128))
+    tie = jnp.zeros((128,), jnp.uint32)
+    slot, found = pq.pop_min_pallas(empty, tie, interpret=True)
+    assert not bool(found.any())
+    sx, fx = pq.pop_min_xla(empty, tie)
+    assert not bool(fx.any())
