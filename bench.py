@@ -1,29 +1,54 @@
 """Benchmark: MadRaft seed-sweep throughput, TPU engine vs host-tier CPU.
 
-Prints ONE JSON line:
-    {"metric": "madraft_sweep_seeds_per_sec", "value": N, "unit": "seeds/s",
-     "vs_baseline": M, ...}
+Prints ONE JSON line whose headline is the largest-batch MadRaft sweep
+(BASELINE.md config #3: 5-node Raft election + replication with
+crash/restart fault injection, 3 virtual seconds per seed), with:
 
-The workload is BASELINE.md config #3 (5-node Raft election with
-crash/restart fault injection, 3 virtual seconds per seed). The baseline is
-the host tier — this framework's own Python deterministic executor running
-the identical workload one seed at a time (the reference publishes no
-numbers, so the stage-1 CPU engine is the measured baseline per
-BASELINE.md). ``vs_baseline`` = device seeds/sec ÷ host seeds/sec.
+- ``batch_curve``: seeds/sec at 4k/16k/64k (throughput scales with the
+  lockstep batch; per-batch compile and run times reported separately);
+- ``sweep_100k``: BASELINE config #5's pod-scale artifact — 131,072
+  seeds run as two 65,536-seed chunks reusing one compiled program;
+- ``recovery_e2e``: config #5's determinism half — a sweep interrupted
+  at 300 steps, checkpointed to .npz, restored, resumed, and verified
+  bit-identical to the uninterrupted run;
+- ``kafka``: BASELINE config #4 as a second workload line (10k-seed
+  broker crash/restart sweep with the acked-loss checker quiet);
+- honest baseline framing: ``vs_baseline`` divides by THIS REPO's
+  single-threaded Python host executor running the same workload — the
+  reference publishes no numbers (BASELINE.md) and its Rust toolchain is
+  not in this image, so ``baseline.reference_note`` records the honest
+  order-of-magnitude estimate instead of a fake ratio.
+
+Timing methodology per docs/pallas_finding.md §0: fresh seed ranges per
+timed run (the tunneled device memoizes same-input executions) and a
+scalar host readback to bound completion.
 """
 
 from __future__ import annotations
 
 import json
+import os
 import sys
+import tempfile
 import time as walltime
 
+import jax
+import jax.numpy as jnp
+import numpy as np
 
 SIM_SECONDS = 3.0
 HOST_SEEDS = 8
-# large default batch: the lockstep engine amortizes per-op dispatch over
-# the seed axis, so throughput grows with batch size
-DEVICE_SEEDS = int(sys.argv[1]) if len(sys.argv) > 1 else 16384
+CURVE = (4096, 16384, 65536)
+BIG_CHUNK = 65536
+BIG_CHUNKS = 2  # 131,072 seeds total — the "100k-seed" artifact
+
+_seed_cursor = [1]
+
+
+def _fresh(n: int) -> jnp.ndarray:
+    lo = _seed_cursor[0]
+    _seed_cursor[0] += n
+    return jnp.arange(lo, lo + n, dtype=jnp.int64)
 
 
 def bench_host() -> float:
@@ -37,52 +62,159 @@ def bench_host() -> float:
     return HOST_SEEDS / (walltime.perf_counter() - t0)
 
 
-def bench_device() -> tuple:
-    """TPU engine: lockstep sweep (seeds/sec, excluding compile)."""
-    import jax
-    import jax.numpy as jnp
-
+def bench_curve(wl, ecfg, raft):
+    """seeds/sec at each batch size; compile time split out per size."""
     from madsim_tpu.engine import core
+
+    curve = []
+    for s in CURVE:
+        t0 = walltime.perf_counter()
+        warm = core.run_sweep(wl, ecfg, _fresh(s))
+        int(warm.ctr.sum())
+        compile_s = walltime.perf_counter() - t0
+        t0 = walltime.perf_counter()
+        final = core.run_sweep(wl, ecfg, _fresh(s))
+        int(final.ctr.sum())
+        run_s = walltime.perf_counter() - t0
+        summary = raft.sweep_summary(final)
+        curve.append(
+            {
+                "seeds": s,
+                "seeds_per_sec": round(s / run_s, 1),
+                "events_per_sec": round(summary["events_total"] / run_s, 1),
+                "sim_sec_per_wall_sec": round(
+                    summary["sim_ns_total"] / run_s / 1e9, 1
+                ),
+                "compile_plus_first_run_s": round(compile_s, 2),
+                "run_s": round(run_s, 3),
+                "violations": summary["violations"],
+            }
+        )
+    return curve
+
+
+def bench_100k(wl, ecfg, raft):
+    """BASELINE config #5 scale: chunked pod-scale sweep, one program."""
+    from madsim_tpu.engine import core
+
+    t0 = walltime.perf_counter()
+    totals = {"violations": 0, "events_total": 0}
+    for _ in range(BIG_CHUNKS):
+        final = core.run_sweep(wl, ecfg, _fresh(BIG_CHUNK))
+        s = raft.sweep_summary(final)
+        totals["violations"] += s["violations"]
+        totals["events_total"] += s["events_total"]
+    wall = walltime.perf_counter() - t0
+    n = BIG_CHUNK * BIG_CHUNKS
+    return {
+        "seeds": n,
+        "chunks": BIG_CHUNKS,
+        "wall_s": round(wall, 2),
+        "seeds_per_sec": round(n / wall, 1),
+        "events_per_sec": round(totals["events_total"] / wall, 1),
+        "violations": totals["violations"],
+    }
+
+
+def bench_recovery(wl, raft_mod):
+    """Config #5 determinism half: interrupt → checkpoint → restore →
+    resume ≡ uninterrupted, bit for bit."""
+    from madsim_tpu.engine import checkpoint, core
+
+    cfg = raft_mod.RaftConfig(num_nodes=5, crashes=1)
+    full_ecfg = raft_mod.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
+    part_ecfg = raft_mod.engine_config(
+        cfg, time_limit_ns=int(SIM_SECONDS * 1e9), max_steps=300
+    )
+    seeds = _fresh(4096)
+    straight = core.run_sweep(wl, full_ecfg, seeds)
+    partial = core.run_sweep(wl, part_ecfg, seeds)
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "mid.npz")
+        checkpoint.save_sweep(partial, path)
+        restored = checkpoint.load_sweep(path, like=partial)
+    resumed = checkpoint.resume_sweep(wl, full_ecfg, restored)
+    identical = all(
+        bool(jnp.array_equal(a, b))
+        for a, b in zip(
+            jax.tree.leaves(
+                (straight.ctr, straight.now_ns, straight.wstate.elections)
+            ),
+            jax.tree.leaves(
+                (resumed.ctr, resumed.now_ns, resumed.wstate.elections)
+            ),
+        )
+    )
+    return {"seeds": 4096, "interrupted_at_step": 300, "bit_identical": identical}
+
+
+def bench_kafka():
+    """BASELINE config #4: broker crash/restart sweep, checker quiet."""
+    from madsim_tpu.engine import core
+    from madsim_tpu.models import kafka
+
+    cfg = kafka.KafkaConfig()
+    ecfg = kafka.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
+    wl = kafka.workload(cfg)
+    warm = core.run_sweep(wl, ecfg, _fresh(10240))
+    int(warm.ctr.sum())
+    t0 = walltime.perf_counter()
+    final = core.run_sweep(wl, ecfg, _fresh(10240))
+    int(final.ctr.sum())
+    run_s = walltime.perf_counter() - t0
+    s = kafka.sweep_summary(final)
+    return {
+        "seeds": 10240,
+        "seeds_per_sec": round(10240 / run_s, 1),
+        "events_per_sec": round(s["events_total"] / run_s, 1),
+        "violations": s["violations"],
+        "broker_crashes": s["crashes"],
+        "records_consumed": s["fetched"],
+    }
+
+
+def main() -> None:
+    from madsim_tpu.engine import core  # noqa: F401  (x64 setup)
     from madsim_tpu.models import raft
 
     cfg = raft.RaftConfig(num_nodes=5, crashes=1)
     ecfg = raft.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
     wl = raft.workload(cfg)
 
-    # warmup = compile; MUST use different seeds than the timed run (the
-    # runtime memoizes same-input executions, which silently produces
-    # fantasy numbers)
-    warm = core.run_sweep(
-        wl, ecfg, jnp.arange(DEVICE_SEEDS, 2 * DEVICE_SEEDS, dtype=jnp.int64)
-    )
-    int(warm.ctr.sum())  # force full materialization of the warmup
-    seeds = jnp.arange(DEVICE_SEEDS, dtype=jnp.int64)
-    t0 = walltime.perf_counter()
-    final = core.run_sweep(wl, ecfg, seeds)
-    # time to host readback — block_until_ready alone under-reports on
-    # asynchronously tunneled devices
-    int(final.ctr.sum())
-    dt = walltime.perf_counter() - t0
-    return DEVICE_SEEDS / dt, raft.sweep_summary(final), dt
-
-
-def main() -> None:
-    device_rate, summary, device_dt = bench_device()
+    curve = bench_curve(wl, ecfg, raft)
+    big = bench_100k(wl, ecfg, raft)
+    recovery = bench_recovery(wl, raft)
+    kafka_line = bench_kafka()
     host_rate = bench_host()
-    sim_ns_per_sec = summary["sim_ns_total"] / device_dt
+
+    head = max(curve, key=lambda c: c["seeds_per_sec"])
     print(
         json.dumps(
             {
                 "metric": "madraft_sweep_seeds_per_sec",
-                "value": round(device_rate, 2),
+                "value": head["seeds_per_sec"],
                 "unit": "seeds/s",
-                "vs_baseline": round(device_rate / host_rate, 3),
-                "baseline_host_seeds_per_sec": round(host_rate, 3),
-                "device_seeds": DEVICE_SEEDS,
-                "sim_seconds_per_wall_sec": round(sim_ns_per_sec / 1e9, 1),
-                "events_per_sec": round(summary["events_total"] / device_dt, 1),
-                "violations": summary["violations"],
-                "backend": __import__("jax").default_backend(),
+                "vs_baseline": round(head["seeds_per_sec"] / host_rate, 1),
+                "baseline": {
+                    "name": "host-tier single-thread Python executor (this repo)",
+                    "seeds_per_sec": round(host_rate, 2),
+                    "reference_note": (
+                        "the Rust reference publishes no benchmark numbers "
+                        "(BASELINE.md) and no Rust toolchain exists in this "
+                        "image to measure it; a compiled single-thread sim "
+                        "executor is typically 10-100x a Python one, so "
+                        "read vs_baseline as 'vs this repo's own host "
+                        "tier', not 'vs the reference'"
+                    ),
+                },
+                "headline_batch": head["seeds"],
+                "events_per_sec": head["events_per_sec"],
+                "sim_seconds_per_wall_sec": head["sim_sec_per_wall_sec"],
+                "batch_curve": curve,
+                "sweep_100k": big,
+                "recovery_e2e": recovery,
+                "kafka": kafka_line,
+                "backend": jax.default_backend(),
             }
         )
     )
