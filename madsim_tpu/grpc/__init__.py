@@ -34,12 +34,15 @@ from .service import (
     service,
     unary,
 )
+from .protogen import ProtoPackage, ProtogenError, compile_protos
 
 __all__ = [
     "Channel",
     "Code",
     "Endpoint",
     "Grpc",
+    "ProtoPackage",
+    "ProtogenError",
     "Request",
     "Response",
     "Server",
@@ -48,6 +51,7 @@ __all__ = [
     "Streaming",
     "bidi_streaming",
     "client_streaming",
+    "compile_protos",
     "server_streaming",
     "service",
     "unary",

@@ -1,0 +1,133 @@
+"""`.proto` ingestion: a real proto file drives sim clients/servers.
+
+The madsim-tonic-build analogue (ref prost.rs:599-680 generates sim stubs
+next to real ones from one proto): compile_protos parses services and
+streaming kinds with protoc, produces REAL protobuf message classes, and
+wires implement()/client() into the simulator's gRPC shim — all four
+streaming modes over a simulated cluster.
+"""
+
+import os
+import tempfile
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu import grpc
+
+PROTO = """
+syntax = "proto3";
+package echotest;
+
+message EchoRequest { string text = 1; int32 n = 2; }
+message EchoReply   { string text = 1; }
+
+service Echo {
+  rpc Say (EchoRequest) returns (EchoReply);
+  rpc Fan (EchoRequest) returns (stream EchoReply);
+  rpc Sum (stream EchoRequest) returns (EchoReply);
+  rpc Chat (stream EchoRequest) returns (stream EchoReply);
+}
+"""
+
+
+def _compile():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "echotest.proto")
+        with open(path, "w") as f:
+            f.write(PROTO)
+        return grpc.compile_protos(path)
+
+
+def test_descriptor_parsing():
+    pkg = _compile()
+    assert "echotest.Echo" in pkg.services
+    assert pkg.services["echotest.Echo"].methods == {
+        "say": "unary",
+        "fan": "server_streaming",
+        "sum": "client_streaming",
+        "chat": "bidi_streaming",
+    }
+    # message classes are real protobufs that round-trip bytes
+    req = pkg.messages["echotest.EchoRequest"](text="hi", n=3)
+    cls = pkg.messages["echotest.EchoRequest"]
+    assert cls.FromString(req.SerializeToString()).text == "hi"
+
+
+def test_proto_service_all_modes_in_sim():
+    pkg = _compile()
+    EchoRequest = pkg.messages["echotest.EchoRequest"]
+    EchoReply = pkg.messages["echotest.EchoReply"]
+
+    @pkg.implement("echotest.Echo")
+    class Echo:
+        async def say(self, request):
+            msg = request.message
+            return EchoReply(text=f"say:{msg.text}")
+
+        async def fan(self, request):
+            msg = request.message
+            for i in range(msg.n):
+                yield EchoReply(text=f"fan{i}:{msg.text}")
+
+        async def sum(self, stream):
+            texts = [m.text async for m in stream]
+            return EchoReply(text="+".join(texts))
+
+        async def chat(self, stream):
+            async for m in stream:
+                yield EchoReply(text=f"re:{m.text}")
+
+    rt = ms.Runtime(seed=21)
+
+    async def main():
+        h = ms.current_handle()
+        addr = "10.0.0.1:700"
+
+        async def serve():
+            await grpc.Server.builder().add_service(Echo()).serve(addr)
+
+        h.create_node().name("server").ip("10.0.0.1").init(lambda: serve()).build()
+        client_node = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            channel = await grpc.Endpoint.from_static(f"http://{addr}").connect()
+            c = pkg.client("echotest.Echo", channel)
+            r = await c.say(EchoRequest(text="x"))
+            assert r.into_inner().text == "say:x"
+            stream = await c.fan(EchoRequest(text="y", n=3))
+            assert [m.text async for m in stream] == [
+                "fan0:y", "fan1:y", "fan2:y",
+            ]
+            r = await c.sum([EchoRequest(text=t) for t in "abc"])
+            assert r.into_inner().text == "a+b+c"
+            stream = await c.chat([EchoRequest(text=t) for t in ("u", "v")])
+            assert [m.text async for m in stream] == ["re:u", "re:v"]
+
+        await client_node.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_unknown_service_and_missing_method_error():
+    pkg = _compile()
+    with pytest.raises(grpc.ProtogenError, match="unknown service"):
+        pkg.client("echotest.Nope", channel=None)
+    with pytest.raises(grpc.ProtogenError, match="missing rpc method"):
+
+        @pkg.implement("echotest.Echo")
+        class Incomplete:
+            async def say(self, request):
+                return None
+
+
+def test_bad_proto_reports_protoc_error():
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "bad.proto")
+        with open(path, "w") as f:
+            f.write('syntax = "proto3";\nmessage Broken {')
+        with pytest.raises(grpc.ProtogenError, match="protoc failed"):
+            grpc.compile_protos(path)
+    with pytest.raises(grpc.ProtogenError, match="no such proto"):
+        grpc.compile_protos("/nonexistent/x.proto")
