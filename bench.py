@@ -148,6 +148,31 @@ def bench_recovery(wl, raft_mod):
     return {"seeds": 4096, "interrupted_at_step": 300, "bit_identical": identical}
 
 
+def bench_etcd():
+    """BASELINE config #2: 3-node KV + lease with partition injection."""
+    from madsim_tpu.engine import core
+    from madsim_tpu.models import etcd
+
+    cfg = etcd.EtcdConfig()
+    ecfg = etcd.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
+    wl = etcd.workload(cfg)
+    warm = core.run_sweep(wl, ecfg, _fresh(8192))
+    int(warm.ctr.sum())
+    t0 = walltime.perf_counter()
+    final = core.run_sweep(wl, ecfg, _fresh(8192))
+    int(final.ctr.sum())
+    run_s = walltime.perf_counter() - t0
+    s = etcd.sweep_summary(final)
+    return {
+        "seeds": 8192,
+        "seeds_per_sec": round(8192 / run_s, 1),
+        "events_per_sec": round(s["events_total"] / run_s, 1),
+        "violations": s["violations"],
+        "partitions": s["partitions"],
+        "lease_expiries": s["expiries"],
+    }
+
+
 def bench_kafka():
     """BASELINE config #4: broker crash/restart sweep, checker quiet."""
     from madsim_tpu.engine import core
@@ -185,6 +210,7 @@ def main() -> None:
     big = bench_100k(wl, ecfg, raft)
     recovery = bench_recovery(wl, raft)
     kafka_line = bench_kafka()
+    etcd_line = bench_etcd()
     host_rate = bench_host()
 
     head = max(curve, key=lambda c: c["seeds_per_sec"])
@@ -214,6 +240,7 @@ def main() -> None:
                 "sweep_100k": big,
                 "recovery_e2e": recovery,
                 "kafka": kafka_line,
+                "etcd": etcd_line,
                 "backend": jax.default_backend(),
             }
         )

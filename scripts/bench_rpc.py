@@ -1,0 +1,94 @@
+"""Real-mode RPC bench — parity with the reference's criterion bench
+(madsim/benches/rpc.rs:11-56: empty-RPC latency + throughput at payload
+sizes 16 B..1 MiB over real loopback).
+
+Runs over BOTH real transports (UDP datagrams and framed TCP) so the
+numbers bound the transport choice. Prints one JSON line.
+
+    python scripts/bench_rpc.py
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+from madsim_tpu import real
+from madsim_tpu.net.rpc import Request
+
+SIZES = [16, 256, 4096, 65536, 1 << 20]
+LAT_ITERS = 2000
+THR_ITERS = 200
+
+
+class Empty(Request):
+    pass
+
+
+class Payload(Request):
+    def __init__(self, data: bytes):
+        self.data = data
+
+
+async def _bench_endpoint(make_endpoint) -> dict:
+    server = await make_endpoint(("127.0.0.1", 0))
+
+    async def on_empty(req):
+        return None
+
+    async def on_payload(req):
+        return len(req.data)
+
+    server.add_rpc_handler(Empty, on_empty)
+    server.add_rpc_handler(Payload, on_payload)
+    client = await make_endpoint(("127.0.0.1", 0))
+    addr = server.local_addr()
+
+    # empty-RPC round-trip latency (rpc.rs:11-27)
+    for _ in range(50):
+        await client.call(addr, Empty())
+    t0 = time.perf_counter()
+    for _ in range(LAT_ITERS):
+        await client.call(addr, Empty())
+    lat_us = (time.perf_counter() - t0) / LAT_ITERS * 1e6
+
+    # throughput by payload size (rpc.rs:29-54)
+    thr = {}
+    for size in SIZES:
+        if size > 60000 and make_endpoint is real.Endpoint.bind:
+            thr[str(size)] = None  # above the UDP datagram ceiling
+            continue
+        blob = b"x" * size
+        n = max(20, THR_ITERS // max(1, size // 4096))
+        for _ in range(5):
+            await client.call(addr, Payload(blob))
+        t0 = time.perf_counter()
+        for _ in range(n):
+            await client.call(addr, Payload(blob))
+        dt = time.perf_counter() - t0
+        thr[str(size)] = round(n * size / dt / 1e6, 1)  # MB/s
+
+    server.close()
+    client.close()
+    return {"empty_rpc_latency_us": round(lat_us, 1), "throughput_mb_s": thr}
+
+
+def main() -> None:
+    rt = real.Runtime()
+
+    async def run():
+        return {
+            "udp": await _bench_endpoint(real.Endpoint.bind),
+            "tcp": await _bench_endpoint(real.TcpEndpoint.bind),
+        }
+
+    out = rt.block_on(run())
+    out["metric"] = "real_mode_rpc"
+    print(json.dumps(out))
+
+
+if __name__ == "__main__":
+    main()

@@ -60,6 +60,40 @@ def test_cross_backend_bit_exact():
     )
 
 
+def test_sharded_parity_kafka_and_etcd_models():
+    """The sharded driver is model-agnostic: both newer device workloads
+    produce bit-identical results sharded vs unsharded."""
+    from madsim_tpu.models import etcd, kafka
+
+    mesh = parallel.seed_mesh(_cpu_devices(8))
+    cases = [
+        (
+            kafka.workload(kafka.KafkaConfig()),
+            kafka.engine_config(
+                kafka.KafkaConfig(), time_limit_ns=1_000_000_000, max_steps=8_000
+            ),
+        ),
+        (
+            etcd.workload(etcd.EtcdConfig()),
+            etcd.engine_config(
+                etcd.EtcdConfig(), time_limit_ns=1_000_000_000, max_steps=8_000
+            ),
+        ),
+    ]
+    for wl, ecfg in cases:
+        seeds = jnp.arange(16, dtype=jnp.int64)
+        sharded = parallel.run_sweep_sharded(wl, ecfg, seeds, mesh)
+        cpu = jax.devices("cpu")[0]
+        with jax.default_device(cpu):
+            plain = ecore.run_sweep(wl, ecfg, seeds)
+        assert jnp.array_equal(
+            jax.device_get(sharded.ctr), jax.device_get(plain.ctr)
+        )
+        assert jnp.array_equal(
+            jax.device_get(sharded.now_ns), jax.device_get(plain.now_ns)
+        )
+
+
 def test_mesh_size_must_divide_batch():
     wl = raft.workload(CFG)
     mesh = parallel.seed_mesh(_cpu_devices(8))
