@@ -18,6 +18,23 @@ from ..engine.core import Emits
 # sentinel for an unused extra slot
 DISABLED = None
 
+# sweep_summary keys that merge by max, not sum, across chunks — owned
+# here so every model's summary and every cross-chunk reducer agree
+MAX_KEYS = frozenset({"queue_high_water"})
+
+
+def merge_summaries(totals: dict, summary: dict) -> dict:
+    """Fold one chunk's ``sweep_summary`` dict into a running total.
+
+    All keys are additive counts except ``MAX_KEYS`` (high-water marks).
+    Mutates and returns ``totals`` (start with ``{}``)."""
+    for k, v in summary.items():
+        if k in MAX_KEYS:
+            totals[k] = max(totals.get(k, 0), v)
+        else:
+            totals[k] = totals.get(k, 0) + v
+    return totals
+
 ExtraSlot = Optional[Tuple]  # (time, kind, pay, enable) or DISABLED
 
 

@@ -402,3 +402,19 @@ def test_cmd_retry_cap_and_giveups_surfaced():
     s = raft.sweep_summary(final)
     assert s["accepted_cmds"] == 0
     assert s["cmd_giveups"] == 8 * cfg.commands  # every command capped out
+
+
+def test_chunked_sweep_matches_unchunked_with_ragged_tail():
+    """run_sweep_chunked splits a sweep into fixed-size program calls
+    (padding + trimming a ragged final chunk) and must be bit-identical
+    per seed to one big run_sweep."""
+    cfg = raft.RaftConfig(num_nodes=3, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=500_000_000, max_steps=4_000)
+    wl = raft.workload(cfg)
+    seeds = jnp.arange(22, dtype=jnp.int64)  # 8+8+6: ragged tail
+    whole = ecore.run_sweep(wl, ecfg, seeds)
+    chunked = ecore.run_sweep_chunked(wl, ecfg, seeds, chunk_size=8)
+    for a, b in zip(jax.tree.leaves(whole), jax.tree.leaves(chunked)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        assert jnp.array_equal(jax.device_get(a), jax.device_get(b))
