@@ -56,6 +56,7 @@ from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, set1
 from ..engine.rng import bounded, prob_to_q32
+from . import _common
 from ._common import pack_extras, pay as _mkpay
 
 # event kinds
@@ -484,27 +485,21 @@ def engine_config(cfg: EtcdConfig = EtcdConfig(), **overrides) -> EngineConfig:
     return EngineConfig(**defaults)
 
 
-def sweep_summary(final) -> dict:
-    """Host-side reduction of a finished sweep's batched EngineState."""
-    import numpy as np
-
-    w: EtcdState = final.wstate
-    return {
-        "seeds": int(final.seed.shape[0]),
-        "violations": int(np.sum(np.asarray(w.violation))),
-        "rev_regress_seeds": int(np.sum(np.asarray(w.vio_rev))),
-        "expiry_seeds": int(np.sum(np.asarray(w.vio_expiry))),
-        "puts": int(np.sum(np.asarray(w.puts))),
-        "gets": int(np.sum(np.asarray(w.gets))),
-        "keepalives": int(np.sum(np.asarray(w.keepalives))),
-        "grants": int(np.sum(np.asarray(w.grants))),
-        "expiries": int(np.sum(np.asarray(w.expiries))),
-        "keys_expired": int(np.sum(np.asarray(w.keys_expired))),
-        "partitions": int(np.sum(np.asarray(w.parts))),
-        "final_rev": int(np.sum(np.asarray(w.rev))),
-        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
-        "queue_high_water": int(np.max(np.asarray(final.qmax))),
-        "events_total": int(np.sum(np.asarray(final.ctr))),
-        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
-        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
-    }
+# one jitted device program for the whole summary (one transfer) — see
+# _common.make_sweep_summary
+sweep_summary = _common.make_sweep_summary(
+    (
+        ("violations", lambda f: jnp.sum(f.wstate.violation)),
+        ("rev_regress_seeds", lambda f: jnp.sum(f.wstate.vio_rev)),
+        ("expiry_seeds", lambda f: jnp.sum(f.wstate.vio_expiry)),
+        ("puts", lambda f: jnp.sum(f.wstate.puts)),
+        ("gets", lambda f: jnp.sum(f.wstate.gets)),
+        ("keepalives", lambda f: jnp.sum(f.wstate.keepalives)),
+        ("grants", lambda f: jnp.sum(f.wstate.grants)),
+        ("expiries", lambda f: jnp.sum(f.wstate.expiries)),
+        ("keys_expired", lambda f: jnp.sum(f.wstate.keys_expired)),
+        ("partitions", lambda f: jnp.sum(f.wstate.parts)),
+        ("final_rev", lambda f: jnp.sum(f.wstate.rev)),
+        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+    )
+)

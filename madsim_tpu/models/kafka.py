@@ -523,26 +523,20 @@ def engine_config(cfg: KafkaConfig = KafkaConfig(), **overrides) -> EngineConfig
     return EngineConfig(**defaults)
 
 
-def sweep_summary(final) -> dict:
-    """Host-side reduction of a finished sweep's batched EngineState."""
-    import numpy as np
-
-    w: KafkaState = final.wstate
-    return {
-        "seeds": int(final.seed.shape[0]),
-        "violations": int(np.sum(np.asarray(w.violation))),
-        "ack_loss_seeds": int(np.sum(np.asarray(w.vio_ack_loss))),
-        "watermark_seeds": int(np.sum(np.asarray(w.vio_watermark))),
-        "produced": int(np.sum(np.asarray(w.produced))),
-        "appended": int(np.sum(np.asarray(w.appended))),
-        "acked": int(np.sum(np.asarray(w.acked))),
-        "fetched": int(np.sum(np.asarray(w.fetched))),
-        "flushes": int(np.sum(np.asarray(w.flushes))),
-        "crashes": int(np.sum(np.asarray(w.crash_count))),
-        "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
-        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
-        "queue_high_water": int(np.max(np.asarray(final.qmax))),
-        "events_total": int(np.sum(np.asarray(final.ctr))),
-        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
-        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
-    }
+# one jitted device program for the whole summary (one transfer) — see
+# _common.make_sweep_summary
+sweep_summary = _common.make_sweep_summary(
+    (
+        ("violations", lambda f: jnp.sum(f.wstate.violation)),
+        ("ack_loss_seeds", lambda f: jnp.sum(f.wstate.vio_ack_loss)),
+        ("watermark_seeds", lambda f: jnp.sum(f.wstate.vio_watermark)),
+        ("produced", lambda f: jnp.sum(f.wstate.produced)),
+        ("appended", lambda f: jnp.sum(f.wstate.appended)),
+        ("acked", lambda f: jnp.sum(f.wstate.acked)),
+        ("fetched", lambda f: jnp.sum(f.wstate.fetched)),
+        ("flushes", lambda f: jnp.sum(f.wstate.flushes)),
+        ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
+        ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
+        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+    )
+)

@@ -659,23 +659,17 @@ def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
     return EngineConfig(**defaults)
 
 
-def sweep_summary(final) -> dict:
-    """Host-side reduction of a finished sweep's batched EngineState."""
-    w: RaftState = final.wstate
-    import numpy as np
-
-    return {
-        "seeds": int(final.seed.shape[0]),
-        "violations": int(np.sum(np.asarray(w.violation))),
-        "elections_total": int(np.sum(np.asarray(w.elections))),
-        "no_leader_seeds": int(np.sum(np.asarray(w.elections) == 0)),
-        "commits_total": int(np.sum(np.asarray(w.commits))),
-        "accepted_cmds": int(np.sum(np.asarray(w.accepted_cmds))),
-        "cmd_giveups": int(np.sum(np.asarray(w.cmd_giveups))),
-        "log_overflow_seeds": int(np.sum(np.asarray(w.log_overflow))),
-        "overflow_seeds": int(np.sum(np.asarray(final.overflow))),
-        "queue_high_water": int(np.max(np.asarray(final.qmax))),
-        "events_total": int(np.sum(np.asarray(final.ctr))),
-        "sim_ns_total": int(np.sum(np.asarray(final.now_ns))),
-        "msgs_delivered": int(np.sum(np.asarray(w.msgs_delivered))),
-    }
+# one jitted device program for the whole summary (one transfer) — see
+# _common.make_sweep_summary
+sweep_summary = _common.make_sweep_summary(
+    (
+        ("violations", lambda f: jnp.sum(f.wstate.violation)),
+        ("elections_total", lambda f: jnp.sum(f.wstate.elections)),
+        ("no_leader_seeds", lambda f: jnp.sum(f.wstate.elections == 0)),
+        ("commits_total", lambda f: jnp.sum(f.wstate.commits)),
+        ("accepted_cmds", lambda f: jnp.sum(f.wstate.accepted_cmds)),
+        ("cmd_giveups", lambda f: jnp.sum(f.wstate.cmd_giveups)),
+        ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
+        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+    )
+)
