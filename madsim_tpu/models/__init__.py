@@ -7,6 +7,6 @@ is MadRaft-style Raft (models/raft.py) — the workload named by the
 BASELINE.md benchmark configs.
 """
 
-from . import etcd, kafka, raft  # noqa: F401
+from . import etcd, kafka, raft, s3  # noqa: F401
 
-__all__ = ["etcd", "kafka", "raft"]
+__all__ = ["etcd", "kafka", "raft", "s3"]

@@ -1,0 +1,628 @@
+"""S3 object store as a device workload — the third ecosystem state machine.
+
+A single S3 server plus ``num_clients`` clients, each driving a random
+op mix — put_object / get_object / delete_object and the full multipart
+lifecycle (create_multipart_upload → upload_part × P → complete) — with
+retry-until-ack request delivery, server crash/restart fault injection,
+and per-message loss/latency, expressed as pure array handlers so
+thousands of seeds run in lockstep on TPU. Together with models/raft.py,
+models/kafka.py, and models/etcd.py this completes the SURVEY §7 stage-6
+workload tier: one substrate, four actor topologies.
+
+Behavior modeled from the reference S3 service state machine
+(madsim-aws-sdk-s3/src/server/service.rs:204-346 — per-(bucket,key)
+objects; multipart parts staged per upload_id and assembled into the
+object body only at complete_multipart_upload; an unknown upload_id is
+NoSuchUpload) plus the crash/restart semantics the reference applies to
+any node (madsim/src/sim/task/mod.rs:347-394). The durability contract
+is S3's: a success response to put/complete promises the object survives
+failures from that moment on. Crash semantics here: committed state
+rolls back to the durable tier, and every staged (uncompleted) multipart
+upload is aborted — its clients observe NoSuchUpload on their next part
+and must restart the upload, exactly the reference's staged-parts model.
+
+Online invariant checkers (any breach latches ``violation``):
+- **acked-object durability**: at crash time, every object version the
+  server has acknowledged (success response generated) must have a
+  durable copy (``last_acked_ver <= ver_dur`` per key). The static
+  ``bug_ack_before_durable`` flag defers durability to a periodic flush
+  while still acking at processing time — the classic ack-before-durable
+  bug — which this checker catches at a reported seed.
+- **monotonic serve**: the version a GET serves for a key never
+  regresses (a regression = a previously served write vanished). Holds
+  structurally in correct mode (commit point == durability point); in
+  bug mode a crash rolls committed state back and later GETs observe it.
+
+Design notes (shared with models/kafka.py):
+- All key/client indexing is one-hot masked (engine/ops.py) — no dynamic
+  scatter/gather on the hot path.
+- Timer staleness uses generation counters: ``sgen`` guards the server's
+  flush-timer chain across crash/restart; multipart uploads are keyed by
+  a server-issued ``gen`` so stale parts/completes from an aborted
+  upload are rejected (NoSuchUpload), and a remembered ``done_gen`` makes
+  complete_multipart_upload idempotent under response loss.
+- Clients are self-clocked state machines: one re-arming op timer per
+  client re-sends the in-flight request until its ack arrives
+  (at-least-once; server-side idempotency via version bumps and the
+  part bitmask).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from ..engine import net as enet
+from ..engine.core import Emits, EngineConfig, Workload
+from ..engine.ops import get1, set1
+from ..engine.rng import bounded, prob_to_q32
+from . import _common
+
+# event kinds
+K_OP = 0  # pay = (client,) — client timer: start or re-send current op
+K_MSG = 1  # pay = (dst_node, mtype, src_node, a, b)
+K_FLUSH = 2  # pay = (sgen,) — server durability timer (bug mode)
+K_CRASH = 3  # server crash (fault plan)
+K_RESTART = 4  # server restart
+
+# message types (pay slots a/b per type)
+MT_PUT = 1  # a = key, b = len
+MT_GET = 2  # a = key
+MT_DEL = 3  # a = key
+MT_CREATE = 4  # a = key
+MT_PART = 5  # a = gen, b = part index
+MT_COMPLETE = 6  # a = gen
+MT_PUT_ACK = 7  # a = version
+MT_GET_RSP = 8  # a = version, b = len (-1 = absent)
+MT_DEL_ACK = 9  # a = version
+MT_CREATE_ACK = 10  # a = gen
+MT_PART_ACK = 11  # a = gen, b = part index
+MT_COMP_ACK = 12  # a = gen
+MT_ERR = 13  # a = gen — NoSuchUpload (service.rs:616-619)
+
+# client phases
+IDLE = 0
+P_PUT = 1
+P_GET = 2
+P_DEL = 3
+P_MPC = 4  # create_multipart_upload sent
+P_MPP = 5  # uploading parts
+P_MPX = 6  # complete_multipart_upload sent
+
+PAYLOAD_SLOTS = 6
+SERVER = 0  # node id of the S3 server
+
+
+class S3Config(NamedTuple):
+    """Static sweep parameters (hashable — part of the jit key)."""
+
+    num_clients: int = 3
+    num_keys: int = 4
+    ops_per_client: int = 10
+    # op mix (out of 8): 3 put, 2 get, 1 delete, 2 multipart
+    parts_per_upload: int = 3
+    part_len: int = 4  # every part is one fixed-size unit
+    max_put_len: int = 4  # put_object length drawn from 1..max_put_len
+    # client op/retry cadence
+    op_lo_ns: int = 30_000_000
+    op_hi_ns: int = 80_000_000
+    # server durability cadence (only meaningful in bug mode — correct
+    # mode makes every commit durable synchronously, the S3 contract)
+    flush_interval_ns: int = 200_000_000
+    # fault plan: server crash/restart events in the first crash_window_ns
+    crashes: int = 1
+    crash_window_ns: int = 3_000_000_000
+    restart_lo_ns: int = 100_000_000
+    restart_hi_ns: int = 800_000_000
+    # network model (reference defaults: 1-10 ms latency)
+    loss_q32: int = prob_to_q32(0.01)
+    lat_lo_ns: int = 1_000_000
+    lat_hi_ns: int = 10_000_000
+    buggify_q32: int = 0
+    # deliberate bug for checker validation: ack at processing time but
+    # defer durability to the periodic flush — crash in between loses
+    # acknowledged objects
+    bug_ack_before_durable: bool = False
+
+    @property
+    def num_nodes(self) -> int:
+        return 1 + self.num_clients
+
+
+class S3State(NamedTuple):
+    # server
+    alive: jnp.ndarray  # bool
+    sgen: jnp.ndarray  # int32 flush-timer generation
+    # committed object table [K] (version 0 = never written, len -1 = absent)
+    ver_com: jnp.ndarray  # int32[K]
+    len_com: jnp.ndarray  # int32[K]
+    # durable tier [K] (== committed in correct mode)
+    ver_dur: jnp.ndarray  # int32[K]
+    len_dur: jnp.ndarray  # int32[K]
+    # checker bookkeeping [K]
+    last_acked_ver: jnp.ndarray  # int32 highest version a success ack promised
+    max_served_ver: jnp.ndarray  # int32 highest version any GET served
+    # multipart staging, one active upload per client [NC]
+    gen_ctr: jnp.ndarray  # int32 upload-id source
+    mp_gen: jnp.ndarray  # int32 registered upload gen (0 = none)
+    mp_key: jnp.ndarray  # int32
+    mp_mask: jnp.ndarray  # int32 bitmask of staged parts
+    mp_done_gen: jnp.ndarray  # int32 last completed gen (idempotent re-ack)
+    # clients [NC]
+    phase: jnp.ndarray  # int32
+    cur_key: jnp.ndarray  # int32
+    cur_len: jnp.ndarray  # int32
+    cur_gen: jnp.ndarray  # int32
+    cur_part: jnp.ndarray  # int32 next part index to upload
+    ops_done: jnp.ndarray  # int32
+    # network
+    links: enet.LinkState
+    # sweep outputs
+    violation: jnp.ndarray  # bool (any checker)
+    vio_ack_loss: jnp.ndarray  # bool
+    vio_regress: jnp.ndarray  # bool
+    puts: jnp.ndarray  # int32 put_object commits
+    gets: jnp.ndarray  # int32 get_object serves
+    dels: jnp.ndarray  # int32 delete_object commits
+    creates: jnp.ndarray  # int32 multipart registrations
+    parts_recv: jnp.ndarray  # int32 distinct parts staged
+    completes: jnp.ndarray  # int32 multipart assemblies
+    upload_restarts: jnp.ndarray  # int32 NoSuchUpload-driven restarts
+    crash_count: jnp.ndarray  # int32 crashes that hit a live server
+    msgs_sent: jnp.ndarray  # int32
+    msgs_delivered: jnp.ndarray  # int32
+
+
+def _pay(*vals) -> jnp.ndarray:
+    return _common.pay(*vals, slots=PAYLOAD_SLOTS)
+
+
+_DISABLED = _common.DISABLED
+
+
+def _emits(*extras) -> Emits:
+    """Every handler emits exactly 2 fixed slots (no broadcasts here)."""
+    return _common.pack_extras(PAYLOAD_SLOTS, *extras)
+
+
+# op mix table: 8 slots → phase started (3/8 put, 2/8 get, 1/8 del, 2/8 mp)
+_OP_PHASE = (P_PUT, P_PUT, P_PUT, P_GET, P_GET, P_DEL, P_MPC, P_MPC)
+# phase → request mtype (IDLE row unused)
+_REQ_MTYPE = (0, MT_PUT, MT_GET, MT_DEL, MT_CREATE, MT_PART, MT_COMPLETE)
+
+
+# -- event handlers (each: (w, now, pay, rand) -> (w, Emits)) ----------------
+
+
+def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
+    """Client c starts a new op (when idle, budget permitting) or re-sends
+    the in-flight request, then re-arms (retry-until-ack)."""
+    c = pay[0]
+    phase = get1(w.phase, c)
+    budget_left = get1(w.ops_done, c) < cfg.ops_per_client
+    start = (phase == IDLE) & budget_left
+
+    op = bounded(rand[3], 0, 8)
+    op_phase = jnp.take(jnp.array(_OP_PHASE, jnp.int32), op)
+    key = bounded(rand[4], 0, cfg.num_keys)
+    plen = bounded(rand[5], 1, cfg.max_put_len + 1)
+
+    phase2 = jnp.where(start, op_phase, phase)
+    key2 = jnp.where(start, jnp.asarray(key, jnp.int32), get1(w.cur_key, c))
+    len2 = jnp.where(start, jnp.asarray(plen, jnp.int32), get1(w.cur_len, c))
+    gen = get1(w.cur_gen, c)
+    part = get1(w.cur_part, c)
+
+    mtype = jnp.take(jnp.array(_REQ_MTYPE, jnp.int32), phase2)
+    a = jnp.where(phase2 >= P_MPP, gen, key2)
+    b = jnp.where(
+        phase2 == P_PUT, len2, jnp.where(phase2 == P_MPP, part, 0)
+    )
+
+    active = phase2 != IDLE
+    node = jnp.asarray(c, jnp.int32) + 1
+    t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
+    send = active & deliver
+    interval = bounded(rand[2], cfg.op_lo_ns, cfg.op_hi_ns)
+    emits = _emits(
+        (t, K_MSG, _pay(SERVER, mtype, node, a, b), send),
+        (now + interval, K_OP, _pay(c), active | budget_left),
+    )
+    w2 = w._replace(
+        phase=set1(w.phase, c, phase2, start),
+        cur_key=set1(w.cur_key, c, key2, start),
+        cur_len=set1(w.cur_len, c, len2, start),
+        msgs_sent=w.msgs_sent + jnp.where(active, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(send, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_msg(cfg: S3Config, w: S3State, now, pay, rand):
+    dst, mtype, src, a, b = pay[0], pay[1], pay[2], pay[3], pay[4]
+    at_server = dst == SERVER
+    alive = w.alive
+    srv = at_server & alive
+    cc = jnp.clip(src - 1, 0, cfg.num_clients - 1)  # requesting client
+    sync = not cfg.bug_ack_before_durable  # static: commit == durable
+
+    # -- server: PUT / DELETE — a version bump on the committed tier; a
+    # delete is a write of "absent" so per-key versions stay monotone
+    # (service.rs:435-479 put/delete both mutate the object entry)
+    is_put = srv & (mtype == MT_PUT)
+    is_del = srv & (mtype == MT_DEL)
+    is_write = is_put | is_del
+    wkey = a
+    wlen = jnp.where(is_put, b, jnp.int32(-1))
+    wver = get1(w.ver_com, wkey) + 1
+
+    # -- server: COMPLETE — assemble staged parts into the object iff the
+    # registration is current and every part arrived (service.rs:302-346);
+    # a stale gen re-acks if it was the last completed one (idempotency),
+    # else NoSuchUpload (service.rs:616-619)
+    is_comp = srv & (mtype == MT_COMPLETE)
+    comp_cur = (get1(w.mp_gen, cc) == a) & (a != 0)
+    full = get1(w.mp_mask, cc) == (1 << cfg.parts_per_upload) - 1
+    do_assemble = is_comp & comp_cur & full
+    akey = get1(w.mp_key, cc)
+    aver = get1(w.ver_com, akey) + 1
+    alen = jnp.int32(cfg.parts_per_upload * cfg.part_len)
+    comp_reack = is_comp & ~comp_cur & (get1(w.mp_done_gen, cc) == a)
+
+    # apply write then assembly (mutually exclusive — different mtypes)
+    ver_com2 = set1(w.ver_com, wkey, wver, is_write)
+    len_com2 = set1(w.len_com, wkey, wlen, is_write)
+    ver_com2 = set1(ver_com2, akey, aver, do_assemble)
+    len_com2 = set1(len_com2, akey, alen, do_assemble)
+    if sync:
+        ver_dur2 = set1(w.ver_dur, wkey, wver, is_write)
+        len_dur2 = set1(w.len_dur, wkey, wlen, is_write)
+        ver_dur2 = set1(ver_dur2, akey, aver, do_assemble)
+        len_dur2 = set1(len_dur2, akey, alen, do_assemble)
+    else:
+        ver_dur2, len_dur2 = w.ver_dur, w.len_dur
+    # durability promise made the moment the success response is generated
+    last_acked2 = set1(w.last_acked_ver, wkey, wver, is_write)
+    last_acked2 = set1(last_acked2, akey, aver, do_assemble)
+
+    mp_gen2 = set1(w.mp_gen, cc, jnp.int32(0), do_assemble)
+    mp_done_gen2 = set1(w.mp_done_gen, cc, a, do_assemble)
+
+    # -- server: GET — serve the committed version; the monotonic-serve
+    # checker latches if a previously served version regressed
+    is_get = srv & (mtype == MT_GET)
+    gver = get1(ver_com2, a)
+    glen = get1(len_com2, a)
+    regress = is_get & (gver < get1(w.max_served_ver, a))
+    max_served2 = set1(
+        w.max_served_ver, a, jnp.maximum(gver, get1(w.max_served_ver, a)), is_get
+    )
+
+    # -- server: CREATE — register (or re-ack) this client's upload; a
+    # fresh server-issued gen is the upload_id (service.rs:243-267)
+    is_create = srv & (mtype == MT_CREATE)
+    has_reg = get1(w.mp_gen, cc) != 0
+    new_gen = w.gen_ctr + 1
+    do_register = is_create & ~has_reg
+    gen_ctr2 = jnp.where(do_register, new_gen, w.gen_ctr)
+    ack_gen = jnp.where(has_reg, get1(w.mp_gen, cc), new_gen)
+    mp_gen2 = set1(mp_gen2, cc, new_gen, do_register)
+    mp_key2 = set1(w.mp_key, cc, a, do_register)
+    mp_mask2 = set1(w.mp_mask, cc, jnp.int32(0), do_register)
+
+    # -- server: PART — stage into the bitmask iff the gen is current
+    # (duplicates from retries are idempotent); stale gen = NoSuchUpload
+    is_part = srv & (mtype == MT_PART)
+    part_cur = (get1(w.mp_gen, cc) == a) & (a != 0)
+    old_mask = get1(mp_mask2, cc)
+    bit = jnp.left_shift(jnp.int32(1), b)
+    fresh_part = is_part & part_cur & ((old_mask & bit) == 0)
+    mp_mask2 = set1(mp_mask2, cc, old_mask | bit, is_part & part_cur)
+
+    # -- server reply (one per request processed while alive)
+    rmt = jnp.select(
+        [
+            is_put,
+            is_del,
+            is_get,
+            is_create,
+            is_part & part_cur,
+            is_part & ~part_cur,
+            do_assemble | comp_reack,
+            is_comp & ~(do_assemble | comp_reack),
+        ],
+        [
+            jnp.int32(MT_PUT_ACK),
+            jnp.int32(MT_DEL_ACK),
+            jnp.int32(MT_GET_RSP),
+            jnp.int32(MT_CREATE_ACK),
+            jnp.int32(MT_PART_ACK),
+            jnp.int32(MT_ERR),
+            jnp.int32(MT_COMP_ACK),
+            jnp.int32(MT_ERR),
+        ],
+        jnp.int32(0),
+    )
+    ra = jnp.select(
+        [is_write, is_get, is_create, is_part | is_comp],
+        [wver, gver, ack_gen, a],
+        jnp.int32(0),
+    )
+    rb = jnp.select([is_get, is_part], [glen, b], jnp.int32(0))
+    # slot 5 echoes the key on put/get/del acks so a delayed ack from an
+    # earlier op can't complete a later op on a different key
+    rkey = jnp.select([is_write, is_get], [wkey, a], jnp.int32(0))
+    did_req = is_write | is_get | is_create | is_part | is_comp
+    rt, rdeliver = enet.route(w.links, now, SERVER, src, rand[0], rand[1])
+    reply_on = did_req & rdeliver
+
+    # -- client: response handling (stale responses gated by phase/gen)
+    at_client = (dst >= 1) & (mtype >= MT_PUT_ACK)
+    rc = jnp.clip(dst - 1, 0, cfg.num_clients - 1)
+    cphase = get1(w.phase, rc)
+    cgen = get1(w.cur_gen, rc)
+    cpart = get1(w.cur_part, rc)
+    key_ok = pay[5] == get1(w.cur_key, rc)
+
+    fin_put = at_client & (mtype == MT_PUT_ACK) & (cphase == P_PUT) & key_ok
+    fin_get = at_client & (mtype == MT_GET_RSP) & (cphase == P_GET) & key_ok
+    fin_del = at_client & (mtype == MT_DEL_ACK) & (cphase == P_DEL) & key_ok
+    got_create = at_client & (mtype == MT_CREATE_ACK) & (cphase == P_MPC)
+    got_part = (
+        at_client
+        & (mtype == MT_PART_ACK)
+        & (cphase == P_MPP)
+        & (a == cgen)
+        & (b == cpart)
+    )
+    last_part = got_part & (cpart + 1 == cfg.parts_per_upload)
+    fin_comp = at_client & (mtype == MT_COMP_ACK) & (cphase == P_MPX) & (a == cgen)
+    got_err = (
+        at_client
+        & (mtype == MT_ERR)
+        & ((cphase == P_MPP) | (cphase == P_MPX))
+        & (a == cgen)
+    )
+    fin_op = fin_put | fin_get | fin_del | fin_comp
+
+    nphase = jnp.select(
+        [fin_op, got_create, last_part, got_part, got_err],
+        [
+            jnp.int32(IDLE),
+            jnp.int32(P_MPP),
+            jnp.int32(P_MPX),
+            jnp.int32(P_MPP),
+            jnp.int32(P_MPC),  # NoSuchUpload → restart the whole upload
+        ],
+        cphase,
+    )
+    touched = fin_op | got_create | got_part | got_err
+    phase2 = set1(w.phase, rc, nphase, touched)
+    cur_gen2 = set1(w.cur_gen, rc, a, got_create)
+    cur_part2 = set1(
+        w.cur_part, rc, jnp.where(got_create, jnp.int32(0), cpart + 1),
+        got_create | got_part,
+    )
+    ops_done2 = set1(w.ops_done, rc, get1(w.ops_done, rc) + 1, fin_op)
+
+    emits = _emits(
+        (rt, K_MSG, _pay(src, rmt, SERVER, ra, rb, rkey), reply_on),
+        _DISABLED,
+    )
+    w2 = w._replace(
+        ver_com=ver_com2,
+        len_com=len_com2,
+        ver_dur=ver_dur2,
+        len_dur=len_dur2,
+        last_acked_ver=last_acked2,
+        max_served_ver=max_served2,
+        gen_ctr=gen_ctr2,
+        mp_gen=mp_gen2,
+        mp_key=mp_key2,
+        mp_mask=mp_mask2,
+        mp_done_gen=mp_done_gen2,
+        phase=phase2,
+        cur_gen=cur_gen2,
+        cur_part=cur_part2,
+        ops_done=ops_done2,
+        vio_regress=w.vio_regress | regress,
+        violation=w.violation | regress,
+        puts=w.puts + jnp.where(is_put, 1, 0),
+        gets=w.gets + jnp.where(is_get, 1, 0),
+        dels=w.dels + jnp.where(is_del, 1, 0),
+        creates=w.creates + jnp.where(do_register, 1, 0),
+        parts_recv=w.parts_recv + jnp.where(fresh_part, 1, 0),
+        completes=w.completes + jnp.where(do_assemble, 1, 0),
+        upload_restarts=w.upload_restarts + jnp.where(got_err, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(did_req, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(reply_on, 1, 0),
+    )
+    return w2, emits
+
+
+def _on_flush(cfg: S3Config, w: S3State, now, pay, rand):
+    """Advance the durable tier to the committed tier (bug mode's only
+    durability point) and re-arm. The chain is only armed in bug mode —
+    correct mode commits durably at processing time, so the flush would
+    be a no-op event every interval (statically gated out in _init /
+    _on_restart)."""
+    gen = pay[0]
+    valid = w.alive & (gen == w.sgen)
+    w2 = w._replace(
+        ver_dur=jnp.where(valid, w.ver_com, w.ver_dur),
+        len_dur=jnp.where(valid, w.len_com, w.len_dur),
+    )
+    emits = _emits(
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(gen), valid),
+        _DISABLED,
+    )
+    return w2, emits
+
+
+def _on_crash(cfg: S3Config, w: S3State, now, pay, rand):
+    """Server crash: committed state rolls back to the durable tier and
+    every staged multipart upload is aborted (ref kill semantics
+    task/mod.rs:347-364). THE checker moment: any acked version without a
+    durable copy is an acknowledged-durability breach."""
+    was_alive = w.alive
+    lost = jnp.any(w.last_acked_ver > w.ver_dur)
+    nc = cfg.num_clients
+    w2 = w._replace(
+        alive=jnp.zeros((), bool),
+        sgen=w.sgen + jnp.where(was_alive, 1, 0),
+        ver_com=jnp.where(was_alive, w.ver_dur, w.ver_com),
+        len_com=jnp.where(was_alive, w.len_dur, w.len_com),
+        mp_gen=jnp.where(was_alive, jnp.zeros((nc,), jnp.int32), w.mp_gen),
+        mp_done_gen=jnp.where(
+            was_alive, jnp.zeros((nc,), jnp.int32), w.mp_done_gen
+        ),
+        vio_ack_loss=w.vio_ack_loss | (was_alive & lost),
+        violation=w.violation | (was_alive & lost),
+        crash_count=w.crash_count + jnp.where(was_alive, 1, 0),
+    )
+    return w2, _emits(_DISABLED, _DISABLED)
+
+
+def _on_restart(cfg: S3Config, w: S3State, now, pay, rand):
+    """Server restart from durable state; fresh flush-timer chain (bug
+    mode only — see _on_flush)."""
+    was_dead = ~w.alive
+    rearm = was_dead if cfg.bug_ack_before_durable else jnp.zeros((), bool)
+    w2 = w._replace(alive=jnp.ones((), bool))
+    emits = _emits(
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(w.sgen), rearm),
+        _DISABLED,
+    )
+    return w2, emits
+
+
+def _handle(cfg: S3Config, w: S3State, now, kind, pay, rand):
+    branches = [
+        partial(_on_op_timer, cfg),
+        partial(_on_msg, cfg),
+        partial(_on_flush, cfg),
+        partial(_on_crash, cfg),
+        partial(_on_restart, cfg),
+    ]
+    return jax.lax.switch(kind, branches, w, now, pay, rand)
+
+
+def _init(cfg: S3Config, key):
+    nc, k = cfg.num_clients, cfg.num_keys
+    ninit = nc + 1 + 2 * cfg.crashes
+    rand = jax.random.bits(
+        jax.random.fold_in(key, 0x7FFF_FFFF), (ninit,), dtype=jnp.uint32
+    )
+    w = S3State(
+        alive=jnp.ones((), bool),
+        sgen=jnp.zeros((), jnp.int32),
+        ver_com=jnp.zeros((k,), jnp.int32),
+        len_com=jnp.full((k,), -1, jnp.int32),
+        ver_dur=jnp.zeros((k,), jnp.int32),
+        len_dur=jnp.full((k,), -1, jnp.int32),
+        last_acked_ver=jnp.zeros((k,), jnp.int32),
+        max_served_ver=jnp.zeros((k,), jnp.int32),
+        gen_ctr=jnp.zeros((), jnp.int32),
+        mp_gen=jnp.zeros((nc,), jnp.int32),
+        mp_key=jnp.zeros((nc,), jnp.int32),
+        mp_mask=jnp.zeros((nc,), jnp.int32),
+        mp_done_gen=jnp.zeros((nc,), jnp.int32),
+        phase=jnp.zeros((nc,), jnp.int32),
+        cur_key=jnp.zeros((nc,), jnp.int32),
+        cur_len=jnp.zeros((nc,), jnp.int32),
+        cur_gen=jnp.zeros((nc,), jnp.int32),
+        cur_part=jnp.zeros((nc,), jnp.int32),
+        ops_done=jnp.zeros((nc,), jnp.int32),
+        links=enet.make(
+            cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
+            cfg.buggify_q32,
+        ),
+        violation=jnp.zeros((), bool),
+        vio_ack_loss=jnp.zeros((), bool),
+        vio_regress=jnp.zeros((), bool),
+        puts=jnp.zeros((), jnp.int32),
+        gets=jnp.zeros((), jnp.int32),
+        dels=jnp.zeros((), jnp.int32),
+        creates=jnp.zeros((), jnp.int32),
+        parts_recv=jnp.zeros((), jnp.int32),
+        completes=jnp.zeros((), jnp.int32),
+        upload_restarts=jnp.zeros((), jnp.int32),
+        crash_count=jnp.zeros((), jnp.int32),
+        msgs_sent=jnp.zeros((), jnp.int32),
+        msgs_delivered=jnp.zeros((), jnp.int32),
+    )
+    times = jnp.zeros((ninit,), jnp.int64)
+    kinds = jnp.zeros((ninit,), jnp.int32)
+    pays = jnp.zeros((ninit, PAYLOAD_SLOTS), jnp.int32)
+    enables = jnp.ones((ninit,), bool)
+    for c in range(nc):
+        times = times.at[c].set(bounded(rand[c], 0, cfg.op_hi_ns))
+        kinds = kinds.at[c].set(K_OP)
+        pays = pays.at[c].set(_pay(c))
+    # first flush tick (bug mode only — see _on_flush)
+    i = nc
+    times = times.at[i].set(jnp.int64(cfg.flush_interval_ns))
+    kinds = kinds.at[i].set(K_FLUSH)
+    pays = pays.at[i].set(_pay(0))
+    if not cfg.bug_ack_before_durable:
+        enables = enables.at[i].set(False)
+    # server crash/restart plan
+    base = nc + 1
+    for j in range(cfg.crashes):
+        t_crash = bounded(rand[base + 2 * j], 0, cfg.crash_window_ns)
+        delay = bounded(
+            rand[base + 2 * j + 1], cfg.restart_lo_ns, cfg.restart_hi_ns
+        )
+        times = times.at[base + 2 * j].set(t_crash)
+        kinds = kinds.at[base + 2 * j].set(K_CRASH)
+        times = times.at[base + 2 * j + 1].set(t_crash + delay)
+        kinds = kinds.at[base + 2 * j + 1].set(K_RESTART)
+    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def workload(cfg: S3Config = S3Config()) -> Workload:
+    """Build the engine Workload for an S3 sweep configuration."""
+    return Workload(
+        init=partial(_init, cfg),
+        handle=partial(_handle, cfg),
+        num_rand=6,
+        payload_slots=PAYLOAD_SLOTS,
+        max_emits=2,
+    )
+
+
+def engine_config(cfg: S3Config = S3Config(), **overrides) -> EngineConfig:
+    """Engine parameters sized for this workload: steady state holds one
+    timer chain + ≤1 in-flight request per client, ≤1 reply per request,
+    the flush chain, and the fault plan."""
+    defaults = dict(
+        queue_capacity=max(48, 4 * cfg.num_clients + 8 + 2 * cfg.crashes),
+        time_limit_ns=5_000_000_000,
+        max_steps=200_000,
+    )
+    defaults.update(overrides)
+    return EngineConfig(**defaults)
+
+
+# one jitted device program for the whole summary (one transfer) — see
+# _common.make_sweep_summary
+sweep_summary = _common.make_sweep_summary(
+    (
+        ("violations", lambda f: jnp.sum(f.wstate.violation)),
+        ("ack_loss_seeds", lambda f: jnp.sum(f.wstate.vio_ack_loss)),
+        ("regress_seeds", lambda f: jnp.sum(f.wstate.vio_regress)),
+        ("puts", lambda f: jnp.sum(f.wstate.puts)),
+        ("gets", lambda f: jnp.sum(f.wstate.gets)),
+        ("dels", lambda f: jnp.sum(f.wstate.dels)),
+        ("creates", lambda f: jnp.sum(f.wstate.creates)),
+        ("parts", lambda f: jnp.sum(f.wstate.parts_recv)),
+        ("completes", lambda f: jnp.sum(f.wstate.completes)),
+        ("upload_restarts", lambda f: jnp.sum(f.wstate.upload_restarts)),
+        ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
+        ("ops_done", lambda f: jnp.sum(f.wstate.ops_done)),
+        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+    )
+)

@@ -61,9 +61,9 @@ def test_cross_backend_bit_exact():
 
 
 def test_sharded_parity_kafka_and_etcd_models():
-    """The sharded driver is model-agnostic: both newer device workloads
-    produce bit-identical results sharded vs unsharded."""
-    from madsim_tpu.models import etcd, kafka
+    """The sharded driver is model-agnostic: every newer device workload
+    produces bit-identical results sharded vs unsharded."""
+    from madsim_tpu.models import etcd, kafka, s3
 
     mesh = parallel.seed_mesh(_cpu_devices(8))
     cases = [
@@ -77,6 +77,12 @@ def test_sharded_parity_kafka_and_etcd_models():
             etcd.workload(etcd.EtcdConfig()),
             etcd.engine_config(
                 etcd.EtcdConfig(), time_limit_ns=1_000_000_000, max_steps=8_000
+            ),
+        ),
+        (
+            s3.workload(s3.S3Config()),
+            s3.engine_config(
+                s3.S3Config(), time_limit_ns=1_000_000_000, max_steps=8_000
             ),
         ),
     ]

@@ -1,0 +1,123 @@
+"""S3 device workload: healthy sweeps are quiet, the ack-before-durable
+bug is caught at a reported seed, and traced CPU replay matches the sweep.
+
+Fourth workload on the shared engine substrate (after Raft, Kafka, etcd):
+an object store with the full multipart lifecycle and crash-abort of
+staged uploads (ref service model:
+madsim-aws-sdk-s3/src/server/service.rs:204-346).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.rng import prob_to_q32
+from madsim_tpu.models import s3
+
+CFG = s3.S3Config()
+ECFG = s3.engine_config(CFG, time_limit_ns=3_000_000_000, max_steps=30_000)
+
+BUG_CFG = CFG._replace(bug_ack_before_durable=True, crashes=2)
+BUG_ECFG = s3.engine_config(BUG_CFG, time_limit_ns=3_000_000_000, max_steps=30_000)
+
+
+def test_healthy_sweep_quiet_and_progresses():
+    final = ecore.run_sweep(s3.workload(CFG), ECFG, jnp.arange(256, dtype=jnp.int64))
+    s = s3.sweep_summary(final)
+    assert s["violations"] == 0, s
+    assert s["ack_loss_seeds"] == 0 and s["regress_seeds"] == 0
+    # every op family actually ran: singles, and the multipart lifecycle
+    assert s["puts"] > 0 and s["gets"] > 0 and s["dels"] > 0
+    assert s["creates"] > 0 and s["parts"] > 0 and s["completes"] > 0
+    # crash-abort of staged uploads was exercised (NoSuchUpload restarts)
+    assert s["upload_restarts"] > 0
+    assert s["crashes"] > 0
+    # bounded structures stayed bounded
+    assert s["overflow_seeds"] == 0
+    assert s["queue_high_water"] <= ECFG.queue_capacity
+
+
+def test_durability_and_version_invariants_in_correct_mode():
+    final = ecore.run_sweep(s3.workload(CFG), ECFG, jnp.arange(128, dtype=jnp.int64))
+    w = final.wstate
+    ver_com = np.asarray(w.ver_com)
+    ver_dur = np.asarray(w.ver_dur)
+    len_com = np.asarray(w.len_com)
+    # the durable tier never leads the committed tier
+    assert (ver_dur <= ver_com).all()
+    # correct mode: every acked version is durable (the S3 contract)
+    assert (np.asarray(w.last_acked_ver) <= ver_dur).all()
+    # committed lengths are absent (-1), a put (1..max), or an assembled
+    # multipart (P * part_len) — never a torn intermediate
+    mp_len = CFG.parts_per_upload * CFG.part_len
+    ok = (
+        (len_com == -1)
+        | ((len_com >= 1) & (len_com <= CFG.max_put_len))
+        | (len_com == mp_len)
+    )
+    assert ok.all()
+
+
+def test_ack_before_durable_bug_is_caught():
+    """The deliberate bug (ack at processing, durability at flush) +
+    server crash = acknowledged-object loss; the online checker must
+    latch it at some seed and the seed must be reported for replay."""
+    final = ecore.run_sweep(
+        s3.workload(BUG_CFG), BUG_ECFG, jnp.arange(512, dtype=jnp.int64)
+    )
+    s = s3.sweep_summary(final)
+    assert s["ack_loss_seeds"] > 0, f"checker failed to catch the bug: {s}"
+    bad = np.asarray(final.seed)[np.asarray(final.wstate.vio_ack_loss)]
+    assert bad.size > 0
+    # every violating seed reproduces under single-seed traced replay on CPU
+    seed = int(bad[0])
+    with jax.default_device(jax.devices("cpu")[0]):
+        replayed, _trace = ecore.run_traced(s3.workload(BUG_CFG), BUG_ECFG, seed)
+    assert bool(replayed.wstate.vio_ack_loss)
+
+
+def test_correct_mode_never_loses_acked_under_same_faults():
+    """Same fault plan as the bug test, correct synchronous durability:
+    the checker stays quiet (the bug is in the policy, not the checker)."""
+    cfg = BUG_CFG._replace(bug_ack_before_durable=False)
+    final = ecore.run_sweep(
+        s3.workload(cfg),
+        s3.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000),
+        jnp.arange(512, dtype=jnp.int64),
+    )
+    s = s3.sweep_summary(final)
+    assert s["violations"] == 0, s
+    assert s["crashes"] > 0  # faults really fired
+
+
+def test_traced_replay_matches_sweep():
+    """Bit-exact cross-check: run_traced on a few seeds reproduces the
+    sweep's per-seed terminal state exactly (the CPU-replay contract)."""
+    wl = s3.workload(CFG)
+    seeds = jnp.arange(6, dtype=jnp.int64)
+    final = ecore.run_sweep(wl, ECFG, seeds)
+    for i in range(6):
+        single, _ = ecore.run_traced(wl, ECFG, int(seeds[i]))
+        assert int(single.ctr) == int(final.ctr[i])
+        assert int(single.now_ns) == int(final.now_ns[i])
+        assert int(single.wstate.completes) == int(final.wstate.completes[i])
+        assert int(single.wstate.gets) == int(final.wstate.gets[i])
+        assert bool(single.wstate.violation) == bool(final.wstate.violation[i])
+
+
+def test_clients_finish_their_op_budget_under_loss():
+    """Retry-until-ack liveness: under 30% packet loss with no crashes,
+    clients still complete (nearly) their whole op budget — a lost
+    request, response, or part ack must never wedge a client."""
+    cfg = CFG._replace(loss_q32=prob_to_q32(0.30), crashes=0)
+    ecfg = s3.engine_config(cfg, time_limit_ns=6_000_000_000, max_steps=60_000)
+    final = ecore.run_sweep(s3.workload(cfg), ecfg, jnp.arange(64, dtype=jnp.int64))
+    ops_done = np.asarray(final.wstate.ops_done)  # [S, NC]
+    assert ops_done.mean() > 0.8 * cfg.ops_per_client, ops_done.mean()
+    assert s3.sweep_summary(final)["violations"] == 0
+
+
+def test_different_seeds_diverge():
+    final = ecore.run_sweep(s3.workload(CFG), ECFG, jnp.arange(32, dtype=jnp.int64))
+    assert len(np.unique(np.asarray(final.ctr))) > 1
