@@ -264,6 +264,41 @@ def _concat_finals(total: int, *finals):
     )
 
 
+def _pad_seeds(seeds, pad: int):
+    """Append ``pad`` synthetic continuation seeds (max real seed + i +
+    1); the padded lanes are sliced off inside ``_concat_finals``."""
+    filler = jnp.max(seeds) + 1 + jnp.arange(pad, dtype=jnp.int64)
+    return jnp.concatenate([seeds, filler])
+
+
+def run_in_chunks(run_chunk, seeds, chunk_size: int, multiple: int = 1):
+    """Shared chunk/pad/concat driver for large sweeps: run
+    ``run_chunk(seed_chunk)`` over sequential ``chunk_size`` slices and
+    concatenate the final states (single trim+concat program).
+
+    A ragged final chunk is padded to the full ``chunk_size`` so every
+    chunk reuses one compiled program; a batch smaller than one chunk is
+    padded only to the next ``multiple`` (divisibility, e.g. a mesh
+    size) — there is no program reuse to justify full-chunk padding."""
+    seeds = jnp.asarray(seeds, jnp.int64)
+    n = int(seeds.shape[0])
+    if n == 0:
+        raise ValueError("seed batch is empty")
+    if n <= chunk_size:
+        pad = -n % multiple
+        if pad == 0:
+            return run_chunk(seeds)
+        return _concat_finals(n, run_chunk(_pad_seeds(seeds, pad)))
+    finals = []
+    for lo in range(0, n, chunk_size):
+        chunk = seeds[lo : lo + chunk_size]
+        pad = chunk_size - chunk.shape[0]
+        if pad:
+            chunk = _pad_seeds(chunk, pad)
+        finals.append(run_chunk(chunk))
+    return _concat_finals(n, *finals)
+
+
 def run_sweep_chunked(
     workload: Workload, cfg: EngineConfig, seeds, chunk_size: int = 16384
 ) -> EngineState:
@@ -281,24 +316,10 @@ def run_sweep_chunked(
     event queues included) — fine to a few hundred thousand seeds on one
     chip. At the million-seed scale, don't hold finals at all: merge
     per-chunk ``sweep_summary`` dicts on host per chunk, as bench.py's
-    bench_100k does. A ragged final chunk is padded with continuation
-    seeds (trimmed inside the single concat program), so every chunk
-    reuses the same compiled sweep."""
-    seeds = jnp.asarray(seeds, jnp.int64)
-    n = seeds.shape[0]
-    if n <= chunk_size:
-        return run_sweep(workload, cfg, seeds)
-    finals = []
-    for lo in range(0, n, chunk_size):
-        chunk = seeds[lo : lo + chunk_size]
-        pad = chunk_size - chunk.shape[0]
-        if pad:
-            # pad with synthetic seeds (max real seed + i + 1); the
-            # padded lanes are sliced off inside _concat_finals
-            filler = jnp.max(seeds) + 1 + jnp.arange(pad, dtype=jnp.int64)
-            chunk = jnp.concatenate([chunk, filler])
-        finals.append(run_sweep(workload, cfg, chunk))
-    return _concat_finals(n, *finals)
+    bench_100k does."""
+    return run_in_chunks(
+        lambda chunk: run_sweep(workload, cfg, chunk), seeds, chunk_size
+    )
 
 
 @partial(jax.jit, static_argnums=(0, 1))

@@ -14,7 +14,14 @@ from .mesh import (
     seed_mesh,
     shard_seeds,
     run_sweep_sharded,
+    run_sweep_sharded_chunked,
     sharded_step,
 )
 
-__all__ = ["seed_mesh", "shard_seeds", "run_sweep_sharded", "sharded_step"]
+__all__ = [
+    "seed_mesh",
+    "shard_seeds",
+    "run_sweep_sharded",
+    "run_sweep_sharded_chunked",
+    "sharded_step",
+]

@@ -131,3 +131,37 @@ def run_sweep_sharded(
 
     state = _init(workload, cfg, seeds)
     return _sharded_run(workload, cfg, mesh)(state)
+
+
+def run_sweep_sharded_chunked(
+    workload: Workload,
+    cfg: EngineConfig,
+    seeds,
+    mesh: Optional[Mesh] = None,
+    chunk_per_device: int = 16384,
+) -> EngineState:
+    """Pod-scale composition of the two scaling axes: the seed batch is
+    sharded over the mesh AND run as sequential fixed-size chunks of one
+    compiled program.
+
+    The ~9x per-lane step-cost cliff above ~16k lanes
+    (engine.core.run_sweep_chunked) is a per-chip working-set limit, so
+    the right chunk is ``chunk_per_device × mesh size`` lanes. A ragged
+    batch is padded with continuation seeds (to the chunk multiple when
+    chunking, or just to mesh divisibility for a single small batch) and
+    trimmed inside one jitted concat. Bit-identical per seed to
+    single-device ``run_sweep``. The returned state keeps O(total seeds)
+    device memory — at the million-seed scale merge per-chunk
+    ``sweep_summary`` dicts on host instead, as bench.py's bench_100k
+    does."""
+    from ..engine.core import run_in_chunks
+
+    if mesh is None:
+        mesh = seed_mesh()
+    n_dev = mesh.devices.size
+    return run_in_chunks(
+        lambda chunk: run_sweep_sharded(workload, cfg, chunk, mesh),
+        seeds,
+        chunk_per_device * n_dev,
+        multiple=n_dev,
+    )
