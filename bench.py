@@ -14,6 +14,8 @@ crash/restart fault injection, 3 virtual seconds per seed), with:
   bit-identical to the uninterrupted run;
 - ``kafka``: BASELINE config #4 as a second workload line (10k-seed
   broker crash/restart sweep with the acked-loss checker quiet);
+- ``etcd``: BASELINE config #2 (8k-seed 3-node KV + lease sweep with
+  partition injection, revision/lease checkers quiet);
 - honest baseline framing: ``vs_baseline`` divides by THIS REPO's
   single-threaded Python host executor running the same workload — the
   reference publishes no numbers (BASELINE.md) and its Rust toolchain is
