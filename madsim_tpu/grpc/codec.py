@@ -52,6 +52,17 @@ class Streaming:
             raise msg[1]
         return msg
 
+    def close(self) -> None:
+        """Drop the response stream mid-flight: closes the underlying
+        connection half, so the server's next send observes
+        BrokenPipeError (the analogue of dropping tonic's ``Streaming``
+        — ref tonic-example/tests/test.rs:205-232; explicit because GC
+        time is nondeterministic in a determinism framework)."""
+        self._done = True
+        close = getattr(self._rx, "close", None)
+        if close is not None:
+            close()
+
     def __aiter__(self) -> "Streaming":
         return self
 

@@ -310,30 +310,76 @@ def sleep_until(deadline: Instant) -> Sleep:
     return Sleep(t, deadline.ns)
 
 
+class _InlineTimeout:
+    """Drive a coroutine to completion WITHIN the current task, bounded
+    by a deadline.
+
+    The reference's ``time::timeout`` polls the inner future inline
+    (time/mod.rs:183-196) — it does not spawn it. That matters for error
+    flow: an exception raised by the timed coroutine must propagate to
+    the awaiter (where a ``try``/``except`` can catch it), not take down
+    a separate task (the executor treats an unhandled task exception as
+    a panic and aborts the simulation). On expiry the coroutine is
+    closed — ``finally`` blocks run, the drop analogue — and
+    :class:`TimeoutError` is raised.
+    """
+
+    __slots__ = ("_coro", "_sleep", "_cur", "_seconds")
+
+    def __init__(self, coro, sleep_fut: Sleep, seconds: float):
+        self._coro = coro
+        self._sleep = sleep_fut
+        self._cur = None  # pollable the inner coroutine is blocked on
+        self._seconds = seconds
+
+    def subscribe(self, task: Any) -> None:
+        self._sleep.subscribe(task)
+        if self._cur is not None:
+            self._cur.subscribe(task)
+
+    def __await__(self):
+        # the finally closes the inner coroutine on EVERY exit — timeout,
+        # and cancellation (GeneratorExit thrown at the yield when the
+        # awaiting task is killed/aborted) — so drop cleanup (finally
+        # blocks, BindGuard releases) runs deterministically, not at GC
+        # time; close() after normal completion is a no-op
+        try:
+            while True:
+                try:
+                    # poll the inner coroutine FIRST (tokio's Timeout
+                    # polls the future before the deadline, so an answer
+                    # that lands on the deadline instant wins; spurious
+                    # re-polls are fine — inner __await__ loops re-yield
+                    # while pending)
+                    self._cur = self._coro.send(None)
+                except StopIteration as stop:
+                    return stop.value
+                if self._sleep.done():
+                    raise TimeoutError(
+                        f"deadline has elapsed after {self._seconds}s"
+                    )
+                yield self
+        finally:
+            self._coro.close()
+
+
 async def timeout(seconds: float, awaitable: Any) -> Any:
     """Await ``awaitable`` with a virtual-time deadline.
 
-    Coroutines are spawned as a task and aborted on timeout (the Python
-    analogue of dropping the future); Future-likes are raced directly.
-    Raises :class:`TimeoutError` on expiry (``time::timeout``,
-    time/mod.rs:183-196).
+    Coroutines are polled inline in the current task and closed on
+    expiry (the drop analogue; exceptions propagate to the awaiter —
+    ``time::timeout``, time/mod.rs:183-196); Future-likes are raced
+    directly. Raises :class:`TimeoutError` on expiry.
     """
     import inspect
 
     from .futures import select
-    from .task import spawn
 
-    spawned = None
     if inspect.iscoroutine(awaitable):
-        spawned = spawn(awaitable)
-        fut = spawned
-    else:
-        fut = awaitable
-    idx, value = await select(fut, sleep(seconds))
+        return await _InlineTimeout(awaitable, sleep(seconds), seconds)
+    idx, value = await select(awaitable, sleep(seconds))
     if idx == 0:
         return value
-    if spawned is not None:
-        spawned.abort()
     raise TimeoutError(f"deadline has elapsed after {seconds}s")
 
 

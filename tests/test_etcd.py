@@ -284,3 +284,17 @@ def test_etcd_determinism():
         return main()
 
     ms.Runtime.check_determinism(31, workload)
+
+
+def test_maintenance_status():
+    """maintenance_client().status() reports server state
+    (ref tests/test.rs:240-263)."""
+
+    async def run():
+        client = await etcd.Client.connect([ADDR])
+        kv = client.kv_client()
+        await kv.put("sk", "sv", None)
+        status = await client.maintenance_client().status()
+        assert status is not None
+
+    with_cluster(97, run)

@@ -302,3 +302,50 @@ def test_determinism_of_grpc_workload():
         return main()
 
     ms.Runtime.check_determinism(77, workload)
+
+
+def test_invalid_address():
+    """Connecting to an address nobody serves fails with an error, not a
+    hang (ref test.rs:141-152)."""
+    rt = ms.Runtime(seed=77)
+
+    async def main():
+        h = ms.current_handle()
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+
+        async def run():
+            ep = grpc.Endpoint.from_static(f"http://{ADDR}").connect_timeout(1.0)
+            with pytest.raises(grpc.Status):
+                await ep.connect()
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_client_drops_response_stream():
+    """Dropping a server-streaming response mid-stream must not wedge the
+    server: it keeps serving (ref test.rs:205-232)."""
+    rt = ms.Runtime(seed=78)
+
+    async def main():
+        h = ms.current_handle()
+        _server, (client,) = cluster(h)
+        await ms.sleep(1.0)
+
+        async def run():
+            c = await connect()
+            stream = await c.lots_of_replies(HelloRequest(name="Tonic"))
+            first = await stream.__anext__()
+            assert first.message == "0: Hello Tonic!"
+            # drop the response stream mid-flight: the server's next
+            # send hits BrokenPipeError and must shut the stream down
+            stream.close()
+            await ms.sleep(10.0)
+            # the server survives and a fresh call succeeds
+            r = await c.say_hello(HelloRequest(name="Tonic"))
+            assert r.into_inner().message == "Hello Tonic!"
+
+        await client.spawn(run())
+
+    rt.block_on(main())

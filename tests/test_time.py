@@ -141,3 +141,58 @@ def test_manual_advance_fires_timers():
         return "woke"
 
     rt.block_on(main())
+
+
+def test_timeout_tie_inner_wins():
+    """tokio's Timeout polls the inner future BEFORE the deadline, so a
+    result landing exactly on the deadline instant is returned, not
+    timed out — both Sleep timers here are created at the same virtual
+    instant with the same duration."""
+    rt = ms.Runtime(seed=61)
+
+    async def inner():
+        await ms.sleep(1.0)
+        return "made it"
+
+    async def main():
+        assert await ms.timeout(1.0, inner()) == "made it"
+
+    rt.block_on(main())
+
+
+def test_timeout_expiry_closes_coroutine_deterministically():
+    """On expiry the timed coroutine is dropped: its finally blocks run
+    before TimeoutError reaches the awaiter (RAII analogue), not at some
+    later GC point."""
+    rt = ms.Runtime(seed=62)
+    cleaned = []
+
+    async def inner():
+        try:
+            await ms.sleep(100.0)
+        finally:
+            cleaned.append(True)
+
+    async def main():
+        with pytest.raises(ms.TimeoutError):
+            await ms.timeout(0.5, inner())
+        assert cleaned == [True]
+
+    rt.block_on(main())
+
+
+def test_timeout_propagates_inner_exception_to_awaiter():
+    """An exception raised by the timed coroutine propagates to the
+    awaiter (inline polling, time/mod.rs:183-196) — it must not abort
+    the simulation as a task panic."""
+    rt = ms.Runtime(seed=63)
+
+    async def inner():
+        await ms.sleep(0.01)
+        raise ValueError("boom")
+
+    async def main():
+        with pytest.raises(ValueError, match="boom"):
+            await ms.timeout(5.0, inner())
+
+    rt.block_on(main())
