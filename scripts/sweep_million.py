@@ -1,0 +1,71 @@
+"""Million-seed MadRaft sweep — the scale demonstration beyond bench.py.
+
+Runs 2**20 = 1,048,576 seeds of BASELINE config #3 (5-node Raft election +
+replication with crash/restart injection, 3 virtual seconds each) as 16k
+chunks of one compiled program, merging per-chunk summaries on host
+(constant device memory — the pattern that extends indefinitely; see
+engine.core.run_sweep_chunked). Prints one JSON line.
+
+Usage: python scripts/sweep_million.py [total_seeds]
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0])
+
+import jax
+import jax.numpy as jnp
+
+from madsim_tpu.engine import core
+from madsim_tpu.models import raft
+from madsim_tpu.models._common import merge_summaries
+
+CHUNK = 16384
+
+
+def main() -> None:
+    total = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    assert total % CHUNK == 0, f"total must be a multiple of {CHUNK}"
+    cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000)
+    wl = raft.workload(cfg)
+
+    # compile once outside the timed region
+    warm = core.run_sweep(wl, ecfg, jnp.arange(CHUNK, dtype=jnp.int64))
+    raft.sweep_summary(warm)
+
+    t0 = time.perf_counter()
+    totals: dict = {}
+    for lo in range(1 << 30, (1 << 30) + total, CHUNK):
+        final = core.run_sweep(
+            wl, ecfg, jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
+        )
+        merge_summaries(totals, raft.sweep_summary(final))
+    wall = time.perf_counter() - t0
+
+    print(
+        json.dumps(
+            {
+                "metric": "madraft_million_seed_sweep",
+                "seeds": total,
+                "chunk_size": CHUNK,
+                "wall_s": round(wall, 2),
+                "seeds_per_sec": round(total / wall, 1),
+                "events_per_sec": round(totals["events_total"] / wall, 1),
+                "sim_sec_per_wall_sec": round(
+                    totals["sim_ns_total"] / wall / 1e9, 1
+                ),
+                "violations": totals["violations"],
+                "elections_total": totals["elections_total"],
+                "backend": jax.default_backend(),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()
