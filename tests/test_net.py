@@ -566,3 +566,90 @@ def test_finished_connections_unregister():
         assert len(net._node_pipes[n1.id]) + len(net._node_pipes[n2.id]) < 30
 
     rt.block_on(main())
+
+
+def test_tcp_partition_recovery():
+    """Port of the reference's disconnect_and_recovery
+    (net/tcp/mod.rs:102-180): a clogged server refuses connects; after
+    unclogging a connection establishes; a mid-stream link partition
+    delays (not drops) flushed writes, which arrive once the partition
+    heals — the reliable-channel backoff-retry path."""
+    rt = ms.Runtime(seed=21)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)  # n2 = server 10.0.1.2
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:900")
+            stream, _peer = await listener.accept()
+            await stream.write_all(b"hello world")
+            await stream.flush()
+            # phase 4: write during the link partition; delivery rides
+            # the backoff retry after the scheduled unclog
+            await ms.sleep(1.0)
+            await stream.write_all(b"after heal")
+            await stream.flush()
+
+        async def client():
+            # phase 2: server clogged — connect cannot complete
+            with pytest.raises(ms.TimeoutError):
+                await ms.timeout(1.0, TcpStream.connect("10.0.1.2:900"))
+            # phase 3: unclogged — connect + first read succeed
+            net.unclog_node(n2.id)
+            stream = await TcpStream.connect("10.0.1.2:900")
+            assert await stream.read_exact(11) == b"hello world"
+            # phase 4: partition both directions; heal after 3 s
+            net.clog_link(n1.id, n2.id)
+            net.clog_link(n2.id, n1.id)
+
+            async def heal():
+                await ms.sleep(3.0)
+                net.unclog_link(n1.id, n2.id)
+                net.unclog_link(n2.id, n1.id)
+
+            ms.spawn(heal())
+            t0 = h.time.now_ns
+            assert await stream.read_exact(10) == b"after heal"
+            # the heal fires exactly 3 s after t0, so a correct run can
+            # never deliver earlier
+            assert h.time.now_ns - t0 >= int(3.0e9)
+
+        n2.spawn(server())
+        net.clog_node(n2.id)
+        task = n1.spawn(client())
+        await task
+
+    rt.block_on(main())
+
+
+def test_tcp_connect_through_ipvs():
+    """TCP connects through a virtual service address, balanced to a
+    real server (ref net/tcp/mod.rs:197-308 ipvs_load_balance)."""
+    rt = ms.Runtime(seed=22)
+
+    async def main():
+        h = ms.current_handle()
+        net = simulator(NetSim)
+        n1, n2 = two_nodes(h)
+        ipvs = net.global_ipvs()
+        svc = ServiceAddr.tcp("10.99.0.5:1000")  # virtual service IP
+        ipvs.add_service(svc)
+        ipvs.add_server(svc, "10.0.1.2:1000")
+
+        async def server():
+            listener = await TcpListener.bind("10.0.1.2:1000")
+            stream, _ = await listener.accept()
+            await stream.write_all(b"via ipvs")
+            await stream.flush()
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await TcpStream.connect("10.99.0.5:1000")
+            assert await stream.read_exact(8) == b"via ipvs"
+
+        n2.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
