@@ -418,3 +418,38 @@ def test_chunked_sweep_matches_unchunked_with_ragged_tail():
         if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
             a, b = jax.random.key_data(a), jax.random.key_data(b)
         assert jnp.array_equal(jax.device_get(a), jax.device_get(b))
+
+
+def test_buggify_latency_spikes_amplify_and_stay_deterministic():
+    """The device-tier buggify spike path (engine/net.py: loss-draw remix
+    gates a 1-5 s latency spike, ref net/mod.rs:287-295): enabling it
+    changes schedules for most seeds, amplifies elections (delayed
+    heartbeats), keeps checkers quiet, and preserves traced-replay
+    parity."""
+    base = raft.RaftConfig(num_nodes=3, crashes=0)
+    # 50%: rare enough to keep clusters mostly healthy, frequent enough
+    # that consecutive delayed heartbeats open election-timeout gaps (a
+    # lone 10% spike rarely does — heartbeats keep resetting the timer)
+    spiky = base._replace(buggify_q32=prob_to_q32(0.50))
+    # spiked (1-5 s) messages accumulate undelivered far beyond the
+    # normal-latency queue sizing — give explicit headroom so the
+    # assertions measure the spike model, not dropped-event artifacts
+    ecfg = raft.engine_config(
+        base, queue_capacity=128, time_limit_ns=2_000_000_000, max_steps=20_000
+    )
+    seeds = jnp.arange(64, dtype=jnp.int64)
+    fb = ecore.run_sweep(raft.workload(base), ecfg, seeds)
+    fs = ecore.run_sweep(raft.workload(spiky), ecfg, seeds)
+    sb, ss = raft.sweep_summary(fb), raft.sweep_summary(fs)
+    assert ss["violations"] == 0, ss
+    assert ss["overflow_seeds"] == 0 and sb["overflow_seeds"] == 0
+    # spikes perturb most seeds' schedules
+    frac_changed = np.mean(np.asarray(fb.ctr) != np.asarray(fs.ctr))
+    assert frac_changed > 0.5, frac_changed
+    # 1-5 s heartbeat spikes against ~150-300 ms election timeouts force
+    # re-elections across the batch
+    assert ss["elections_total"] > sb["elections_total"], (sb, ss)
+    # replay parity holds on the buggified config
+    single, _ = ecore.run_traced(raft.workload(spiky), ecfg, int(seeds[3]))
+    assert int(single.ctr) == int(fs.ctr[3])
+    assert bool(single.wstate.violation) == bool(fs.wstate.violation[3])
