@@ -62,3 +62,100 @@ def resume_sweep(
     from .core import _drive
 
     return _drive(workload, cfg, state)  # shares run_sweep's trace cache
+
+
+def run_sweep_chunked_resumable(
+    workload: Workload,
+    cfg: EngineConfig,
+    seeds,
+    summarize,
+    ckpt_dir: str,
+    chunk_size: int = 16384,
+) -> dict:
+    """Pod-scale sweep that survives interruption at chunk granularity.
+
+    Runs ``seeds`` as sequential ``chunk_size`` batches; after each chunk
+    its ``summarize(final)`` dict is written atomically to ``ckpt_dir``,
+    and a restarted call skips every chunk whose summary file already
+    exists — sound because chunks are deterministic (re-running one
+    yields bit-identical results). Returns the merged summary totals
+    (per-chunk host merge, constant device memory — the million-seed
+    pattern of scripts/sweep_million.py made preemption-safe; BASELINE
+    config #5's recovery semantics at pod scale).
+
+    Stale-reuse guard: each file records its seed range AND a
+    fingerprint of the workload + engine config; a mismatch (the
+    directory belongs to a different sweep) raises instead of silently
+    merging foreign counts. For mid-chunk snapshots of in-flight state
+    use ``save_sweep``/``resume_sweep`` instead.
+    """
+    import json
+    import os
+
+    from .core import _concat_finals, _pad_seeds, run_sweep
+    from ..models._common import merge_summaries  # lazy: models import us
+
+    seeds = jnp.asarray(seeds, jnp.int64)
+    n = int(seeds.shape[0])
+    if n == 0:
+        raise ValueError("seed batch is empty")
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    fp = _sweep_fingerprint(workload, cfg)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    totals: dict = {}
+    for lo in range(0, n, chunk_size):
+        chunk = seeds[lo : lo + chunk_size]
+        k = int(chunk.shape[0])
+        first, last = int(chunk[0]), int(chunk[-1])
+        path = os.path.join(ckpt_dir, f"chunk_{lo:010d}_{k}.json")
+        if os.path.exists(path):
+            with open(path) as f:
+                rec = json.load(f)
+            if (
+                rec["first_seed"] != first
+                or rec["last_seed"] != last
+                or rec.get("fingerprint") != fp
+            ):
+                raise ValueError(
+                    f"checkpoint {path} is from a different sweep: holds "
+                    f"seeds [{rec['first_seed']}, {rec['last_seed']}] with "
+                    f"fingerprint {rec.get('fingerprint')!r}, expected "
+                    f"[{first}, {last}] with {fp!r}"
+                )
+            summary = rec["summary"]
+        else:
+            # pad a ragged final chunk so it reuses the one compiled
+            # sweep program (a fresh batch shape recompiles for seconds);
+            # padded lanes are trimmed inside one jitted program
+            pad = chunk_size - k
+            final = run_sweep(
+                workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
+            )
+            if pad:
+                final = _concat_finals(k, final)
+            summary = summarize(final)
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                json.dump(
+                    {
+                        "first_seed": first,
+                        "last_seed": last,
+                        "fingerprint": fp,
+                        "summary": summary,
+                    },
+                    f,
+                )
+            os.replace(tmp, path)  # atomic: a crash never leaves half a file
+        merge_summaries(totals, summary)
+    return totals
+
+
+def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
+    """Identity of (model, model config, engine config) for the resumable
+    sweep's stale-checkpoint guard. Model configs are NamedTuples of
+    plain values, so their repr is a stable fingerprint."""
+    init = workload.init
+    fn = getattr(init, "func", init)
+    args = getattr(init, "args", ())
+    return f"{fn.__module__}.{fn.__qualname__}|{args!r}|{tuple(cfg)!r}"

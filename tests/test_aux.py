@@ -178,3 +178,64 @@ def test_cond_interval_validated():
     wl = raft.workload(cfg)
     with pytest.raises(ValueError, match="cond_interval"):
         ecore.init_sweep(wl, ecfg, jnp.arange(2, dtype=jnp.int64))
+
+
+def test_resumable_chunked_sweep(tmp_path, monkeypatch):
+    """Interrupted pod-scale sweeps resume at chunk granularity: completed
+    chunks load from their summary files (zero device work), totals match
+    an uninterrupted whole-batch run, and a directory from a different
+    sweep is rejected instead of silently merged."""
+    import madsim_tpu.engine.core as ecore_mod
+    from madsim_tpu.engine import checkpoint, core as ecore
+    from madsim_tpu.models import raft
+
+    cfg = raft.RaftConfig(num_nodes=3, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=500_000_000, max_steps=4_000)
+    wl = raft.workload(cfg)
+    seeds = jnp.arange(22, dtype=jnp.int64)  # 8+8+6: ragged final chunk
+    d = str(tmp_path / "ckpts")
+
+    totals = checkpoint.run_sweep_chunked_resumable(
+        wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=8
+    )
+    # ground truth: one whole-batch run (additive keys sum per chunk)
+    whole = raft.sweep_summary(ecore.run_sweep(wl, ecfg, seeds))
+    assert totals["events_total"] == whole["events_total"]
+    assert totals["violations"] == whole["violations"]
+    assert totals["queue_high_water"] == whole["queue_high_water"]
+
+    # restart: every chunk must load from disk — no sweep may run
+    def boom(*a, **k):
+        raise AssertionError("run_sweep called on a fully-checkpointed sweep")
+
+    monkeypatch.setattr(ecore_mod, "run_sweep", boom)
+    resumed = checkpoint.run_sweep_chunked_resumable(
+        wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=8
+    )
+    assert resumed == totals
+    monkeypatch.undo()
+
+    # partial restart: drop one chunk file, only that chunk re-runs
+    files = sorted(p for p in (tmp_path / "ckpts").iterdir() if p.suffix == ".json")
+    assert len(files) == 3
+    files[1].unlink()
+    again = checkpoint.run_sweep_chunked_resumable(
+        wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=8
+    )
+    assert again == totals
+
+    # foreign-sweep guards: different seeds, and same seeds under a
+    # different engine config — both must refuse the stale directory
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpoint.run_sweep_chunked_resumable(
+            wl, ecfg, seeds + 1000, raft.sweep_summary, d, chunk_size=8
+        )
+    other = raft.engine_config(cfg, time_limit_ns=900_000_000, max_steps=4_000)
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpoint.run_sweep_chunked_resumable(
+            wl, other, seeds, raft.sweep_summary, d, chunk_size=8
+        )
+    with pytest.raises(ValueError, match="chunk_size"):
+        checkpoint.run_sweep_chunked_resumable(
+            wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=-1
+        )
