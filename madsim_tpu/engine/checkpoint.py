@@ -96,6 +96,7 @@ def run_sweep_chunked_resumable(
     from ..models._common import merge_summaries  # lazy: models import us
 
     seeds = jnp.asarray(seeds, jnp.int64)
+    seeds_host = np.asarray(seeds)  # bookkeeping reads skip the device
     n = int(seeds.shape[0])
     if n == 0:
         raise ValueError("seed batch is empty")
@@ -105,9 +106,8 @@ def run_sweep_chunked_resumable(
     os.makedirs(ckpt_dir, exist_ok=True)
     totals: dict = {}
     for lo in range(0, n, chunk_size):
-        chunk = seeds[lo : lo + chunk_size]
-        k = int(chunk.shape[0])
-        first, last = int(chunk[0]), int(chunk[-1])
+        k = min(chunk_size, n - lo)
+        first, last = int(seeds_host[lo]), int(seeds_host[lo + k - 1])
         path = os.path.join(ckpt_dir, f"chunk_{lo:010d}_{k}.json")
         if os.path.exists(path):
             with open(path) as f:
@@ -128,6 +128,7 @@ def run_sweep_chunked_resumable(
             # pad a ragged final chunk so it reuses the one compiled
             # sweep program (a fresh batch shape recompiles for seconds);
             # padded lanes are trimmed inside one jitted program
+            chunk = seeds[lo : lo + chunk_size]
             pad = chunk_size - k
             final = run_sweep(
                 workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
