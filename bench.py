@@ -213,12 +213,14 @@ def main() -> None:
     ecfg = raft.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
     wl = raft.workload(cfg)
 
+    # host tier first: measured before device churn (GC/allocator
+    # pressure from the TPU runs costs it ~2x)
+    host_rate = bench_host()
     curve = bench_curve(wl, ecfg, raft)
     big = bench_100k(wl, ecfg, raft)
     recovery = bench_recovery(wl, raft)
     kafka_line = bench_kafka()
     etcd_line = bench_etcd()
-    host_rate = bench_host()
 
     head = max(curve, key=lambda c: c["seeds_per_sec"])
     print(
