@@ -52,6 +52,29 @@ class Mailbox:
             self.registered.setdefault(tag, []).append(fut)
         return fut
 
+    def drop_recv(self, tag: int, fut: "Future") -> None:
+        """A receiver was dropped (timeout/kill) before consuming: remove
+        its registration; if a message already resolved into the dead
+        oneshot, hand it to the next live waiter, else put it back at the
+        FRONT of the undelivered queue (it arrived earliest). The ref's
+        analogue is Mailbox oneshot-drop semantics (endpoint.rs:297-363:
+        a dropped oneshot's send fails and the message is buffered) — a
+        dropped recv never swallows a message."""
+        waiters = self.registered.get(tag)
+        if waiters is not None and fut in waiters:
+            waiters.remove(fut)
+            if not waiters:
+                del self.registered[tag]
+            return
+        if fut.done() and fut.exception() is None:
+            payload, src = fut.result()
+            while waiters:
+                w = waiters.pop(0)
+                if not w.done():
+                    w.set_result((payload, src))
+                    return
+            self.undelivered.setdefault(tag, deque()).appendleft((payload, src))
+
 
 class BindGuard:
     """RAII-ish port release (ref ``BindGuard``, net/mod.rs:436-494):
@@ -167,8 +190,20 @@ class Endpoint:
         )
 
     async def recv_from_raw(self, tag: int) -> Tuple[Any, Addr]:
-        payload, src = await self._socket.mailbox.recv(tag)
-        await self._netsim.rand_delay()
+        mailbox = self._socket.mailbox
+        fut = mailbox.recv(tag)
+        try:
+            payload, src = await fut
+            # rand_delay inside the try: a drop landing between
+            # resolution and return must also requeue, not lose
+            await self._netsim.rand_delay()
+        except BaseException:
+            # dropped mid-wait (timeout expiry / task kill closes the
+            # coroutine): release the mailbox slot — or requeue an
+            # already-resolved message — so nothing is swallowed by a
+            # dead receiver
+            mailbox.drop_recv(tag, fut)
+            raise
         return payload, src
 
     async def send_to(self, dst: "str | Addr", tag: int, data: bytes) -> None:

@@ -653,3 +653,59 @@ def test_tcp_connect_through_ipvs():
         await n1.spawn(client())
 
     rt.block_on(main())
+
+
+def test_receiver_drop_message_not_lost():
+    """A recv that times out drops its mailbox registration; a message
+    arriving afterwards must be buffered for the NEXT recv, not swallowed
+    by the dead one (ref endpoint.rs receiver_drop, endpoint.rs:46-81)."""
+    rt = ms.Runtime(seed=23)
+
+    async def main():
+        h = ms.current_handle()
+        n1, n2 = two_nodes(h)
+
+        async def sender():
+            ep = await Endpoint.bind("10.0.1.1:700")
+            await ms.sleep(2.0)  # after the receiver's timeout expires
+            await ep.send_to("10.0.1.2:700", 1, b"late")
+
+        async def receiver():
+            ep = await Endpoint.bind("10.0.1.2:700")
+            with pytest.raises(ms.TimeoutError):
+                await ms.timeout(1.0, ep.recv_from(1))
+            # dead registration dropped; the message arrives (t≈2s)
+            # while no receiver is waiting, then a fresh recv gets it
+            await ms.sleep(2.0)
+            data, src = await ep.recv_from(1)
+            assert data == b"late"
+            assert src[0] == "10.0.1.1"
+
+        n1.spawn(sender())
+        await n2.spawn(receiver())
+
+    rt.block_on(main())
+
+
+def test_mailbox_drop_resolved_recv_hands_message_to_live_waiter():
+    """If a message already resolved into a receiver that is then
+    dropped unconsumed, it goes to the next live waiter on the tag (not
+    the undelivered queue, which would strand it while that waiter
+    blocks); with no waiter it returns to the FRONT of the queue."""
+    from madsim_tpu.net.endpoint import Mailbox
+
+    mb = Mailbox()
+    a = mb.recv(1)
+    b = mb.recv(1)
+    mb.deliver(1, b"m", ("10.0.1.1", 9))
+    assert a.done() and not b.done()
+    mb.drop_recv(1, a)  # a was aborted before consuming
+    assert b.done() and b.result() == (b"m", ("10.0.1.1", 9))
+
+    # no live waiter: requeued at the front, ahead of later arrivals
+    c = mb.recv(2)
+    mb.deliver(2, b"first", ("10.0.1.1", 9))
+    mb.deliver(2, b"second", ("10.0.1.1", 9))
+    mb.drop_recv(2, c)
+    assert mb.recv(2).result()[0] == b"first"
+    assert mb.recv(2).result()[0] == b"second"
