@@ -23,7 +23,7 @@ Python-native:
 
 from .status import Code, Status
 from .codec import Streaming
-from .channel import Channel, Endpoint
+from .channel import Change, Channel, Endpoint
 from .server import Server
 from .client import Grpc, Request, Response
 from .service import (
@@ -37,6 +37,7 @@ from .service import (
 from .protogen import ProtoPackage, ProtogenError, compile_protos
 
 __all__ = [
+    "Change",
     "Channel",
     "Code",
     "Endpoint",

@@ -242,28 +242,36 @@ def test_request_timeout():
     rt.block_on(main())
 
 
+@grpc.service("helloworld.WhoAmI")
+class WhoAmI:
+    """Identifies which balanced backend served a call."""
+
+    def __init__(self, tag: str = "?"):
+        self.tag = tag
+
+    @grpc.unary
+    async def who(self, request):
+        return HelloReply(message=self.tag)
+
+
+def tagged_cluster(h, ips):
+    """One WhoAmI server per ip, tagged s0, s1, ... (balance tests)."""
+    for i, ip in enumerate(ips):
+        h.create_node().name(f"s{i}").ip(ip).init(
+            lambda i=i, ip=ip: grpc.Server.builder()
+            .add_service(WhoAmI(tag=f"s{i}"))
+            .serve(f"{ip}:50051")
+        ).build()
+
+
 def test_balance_list_round_robin_random():
     """balance_list spreads calls over endpoints at random
     (ref transport/channel.rs:294-307)."""
     rt = ms.Runtime(seed=16)
 
-    @grpc.service("helloworld.WhoAmI")
-    class WhoAmI:
-        def __init__(self, tag: str = "?"):
-            self.tag = tag
-
-        @grpc.unary
-        async def who(self, request):
-            return HelloReply(message=self.tag)
-
     async def main():
         h = ms.current_handle()
-        for i, ip in enumerate(["10.0.1.1", "10.0.1.2", "10.0.1.3"]):
-            h.create_node().name(f"s{i}").ip(ip).init(
-                lambda i=i, ip=ip: grpc.Server.builder()
-                .add_service(WhoAmI(tag=f"s{i}"))
-                .serve(f"{ip}:50051")
-            ).build()
+        tagged_cluster(h, ["10.0.1.1", "10.0.1.2", "10.0.1.3"])
         client = h.create_node().name("client").ip("10.0.0.2").build()
         await ms.sleep(0.1)
 
@@ -345,6 +353,46 @@ def test_client_drops_response_stream():
             # the server survives and a fresh call succeeds
             r = await c.say_hello(HelloRequest(name="Tonic"))
             assert r.into_inner().message == "Hello Tonic!"
+
+        await client.spawn(run())
+
+    rt.block_on(main())
+
+
+def test_balance_channel_dynamic_endpoints():
+    """balance_channel: endpoints inserted/removed at runtime via
+    Change items steer subsequent calls (ref transport/channel.rs:335-359
+    tower-discover semantics); an empty set is Unavailable."""
+    rt = ms.Runtime(seed=79)
+
+    async def main():
+        h = ms.current_handle()
+        tagged_cluster(h, ["10.0.1.1", "10.0.1.2"])
+        client = h.create_node().name("client").ip("10.0.0.2").build()
+        await ms.sleep(0.1)
+
+        async def run():
+            channel, tx = grpc.Channel.balance_channel()
+            c = grpc.ServiceClient(WhoAmI, channel)
+            # empty endpoint set: Unavailable, not a hang
+            with pytest.raises(grpc.Status) as e:
+                await c.who(HelloRequest(name="x"))
+            assert e.value.code == grpc.Code.UNAVAILABLE
+            await tx.send(
+                grpc.Change.insert("a", grpc.Endpoint.from_static("http://10.0.1.1:50051"))
+            )
+            await tx.send(
+                grpc.Change.insert("b", grpc.Endpoint.from_static("http://10.0.1.2:50051"))
+            )
+            seen = set()
+            for _ in range(20):
+                seen.add((await c.who(HelloRequest(name="x"))).into_inner().message)
+            assert seen == {"s0", "s1"}
+            # remove one backend: traffic converges on the survivor
+            await tx.send(grpc.Change.remove("a"))
+            for _ in range(10):
+                r = await c.who(HelloRequest(name="x"))
+                assert r.into_inner().message == "s1"
 
         await client.spawn(run())
 
