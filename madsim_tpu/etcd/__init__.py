@@ -24,13 +24,27 @@ Errors are ``grpc.Status`` values, matching the reference's use of tonic
 """
 
 from .client import (
+    CampaignResponse,
     Client,
     ConnectOptions,
+    DeleteResponse,
     ElectionClient,
+    GetResponse,
     KvClient,
+    LeaderKey,
+    LeaderResponse,
     LeaseClient,
+    LeaseGrantResponse,
+    LeaseKeepAliveResponse,
+    LeaseTimeToLiveResponse,
     MaintenanceClient,
+    ObserveStream,
+    PutResponse,
+    ResponseHeader,
+    StatusResponse,
+    TxnResponse,
     WatchClient,
+    WatchStream,
 )
 from .server import SimServer
 from .service import (
@@ -47,22 +61,36 @@ from .service import (
 )
 
 __all__ = [
+    "CampaignResponse",
     "Client",
     "Compare",
     "CompareOp",
     "ConnectOptions",
     "DeleteOptions",
+    "DeleteResponse",
     "ElectionClient",
     "Event",
     "EventType",
     "GetOptions",
+    "GetResponse",
     "KeyValue",
     "KvClient",
+    "LeaderKey",
+    "LeaderResponse",
     "LeaseClient",
+    "LeaseGrantResponse",
+    "LeaseKeepAliveResponse",
+    "LeaseTimeToLiveResponse",
     "MaintenanceClient",
+    "ObserveStream",
     "PutOptions",
+    "PutResponse",
+    "ResponseHeader",
     "SimServer",
+    "StatusResponse",
     "Txn",
     "TxnOp",
+    "TxnResponse",
     "WatchClient",
+    "WatchStream",
 ]

@@ -12,8 +12,25 @@ enum per ``connect1`` exchange (madsim-aws-sdk-s3/src/client.rs:29-57) to a
     out = await (await client.get_object().bucket("b").key("k").send()).body()
 """
 
-from .client import Client
+from .client import (
+    ByteStream,
+    Client,
+    CompletedMultipartUpload,
+    CompletedPart,
+    Delete,
+    ObjectIdentifier,
+)
 from .server import SimServer
 from .service import S3Error, S3Service
 
-__all__ = ["Client", "S3Error", "S3Service", "SimServer"]
+__all__ = [
+    "ByteStream",
+    "Client",
+    "CompletedMultipartUpload",
+    "CompletedPart",
+    "Delete",
+    "ObjectIdentifier",
+    "S3Error",
+    "S3Service",
+    "SimServer",
+]

@@ -6,7 +6,7 @@ import pytest
 
 import madsim_tpu as ms
 from madsim_tpu import s3
-from madsim_tpu.s3.client import (
+from madsim_tpu.s3 import (
     ByteStream,
     CompletedMultipartUpload,
     CompletedPart,
