@@ -27,8 +27,12 @@ package tree for what is present in this revision.)
 __version__ = "0.1.0"
 
 from . import buggify as buggify
+from . import fs as fs
 from . import rand as rand
+from . import signal as signal
+from . import sync as sync
 from . import time as time
+from . import tracing as tracing
 from .builder import Builder, main, sim_test
 from .context import current_handle, current_node, current_task
 from .futures import Future, JoinHandle, select, join, pending_forever
@@ -51,6 +55,7 @@ __all__ = [
     "current_node",
     "current_task",
     "exit_current_task",
+    "fs",
     "init_logger",
     "interval",
     "join",
@@ -58,11 +63,14 @@ __all__ = [
     "pending_forever",
     "rand",
     "select",
+    "signal",
     "sim_test",
     "sleep",
     "sleep_until",
     "spawn",
     "spawn_local",
+    "sync",
     "time",
     "timeout",
+    "tracing",
 ]
