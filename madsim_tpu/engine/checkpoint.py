@@ -83,12 +83,13 @@ def run_sweep_chunked_resumable(
     pattern of scripts/sweep_million.py made preemption-safe; BASELINE
     config #5's recovery semantics at pod scale).
 
-    Stale-reuse guard: each file records its seed range AND a
-    fingerprint of the workload + engine config; a mismatch (the
-    directory belongs to a different sweep) raises instead of silently
-    merging foreign counts. For mid-chunk snapshots of in-flight state
+    Stale-reuse guard: each file records its seed range, a sha256 of
+    the chunk's full seed array, AND a fingerprint of the workload +
+    engine config; a mismatch (the directory belongs to a different
+    sweep) raises instead of silently merging foreign counts. For mid-chunk snapshots of in-flight state
     use ``save_sweep``/``resume_sweep`` instead.
     """
+    import hashlib
     import json
     import os
 
@@ -108,20 +109,30 @@ def run_sweep_chunked_resumable(
     for lo in range(0, n, chunk_size):
         k = min(chunk_size, n - lo)
         first, last = int(seeds_host[lo]), int(seeds_host[lo + k - 1])
+        # endpoints alone can collide across different seed vectors
+        # ([0,5,9] vs [0,7,9]); hash the whole chunk's seeds
+        seeds_sha = hashlib.sha256(
+            np.ascontiguousarray(seeds_host[lo : lo + k]).tobytes()
+        ).hexdigest()
         path = os.path.join(ckpt_dir, f"chunk_{lo:010d}_{k}.json")
         if os.path.exists(path):
             with open(path) as f:
                 rec = json.load(f)
+            # records from before the sha was added lack the key; their
+            # endpoint+fingerprint check still applies (legacy-compatible)
+            rec_sha = rec.get("seeds_sha256", seeds_sha)
             if (
                 rec["first_seed"] != first
                 or rec["last_seed"] != last
+                or rec_sha != seeds_sha
                 or rec.get("fingerprint") != fp
             ):
                 raise ValueError(
                     f"checkpoint {path} is from a different sweep: holds "
-                    f"seeds [{rec['first_seed']}, {rec['last_seed']}] with "
+                    f"seeds [{rec['first_seed']}, {rec['last_seed']}] "
+                    f"(sha {rec.get('seeds_sha256')!r}) with "
                     f"fingerprint {rec.get('fingerprint')!r}, expected "
-                    f"[{first}, {last}] with {fp!r}"
+                    f"[{first}, {last}] (sha {seeds_sha!r}) with {fp!r}"
                 )
             summary = rec["summary"]
         else:
@@ -142,6 +153,7 @@ def run_sweep_chunked_resumable(
                     {
                         "first_seed": first,
                         "last_seed": last,
+                        "seeds_sha256": seeds_sha,
                         "fingerprint": fp,
                         "summary": summary,
                     },

@@ -38,7 +38,7 @@ _BLOCK = 128  # seeds per grid step
 # python ints (a jnp scalar would be captured as a traced kernel constant)
 _INV_HI = int(INVALID_TIME) >> 32  # 0x7fffffff
 _SIGN = 0x80000000
-_INV_LO_BIASED = (0xFFFFFFFF ^ _SIGN) - (1 << 32)  # as signed int32 (-1^sign)
+_INV_LO_BIASED = 0x7FFFFFFF  # sign-biased lo half of INVALID_TIME as signed int32
 
 
 def _murmur_prio(iota_u32, tie_u32):

@@ -28,6 +28,7 @@ clients/servers wired into the simulator without hand-decorating anything.
 
 from __future__ import annotations
 
+import hashlib
 import importlib.util
 import os
 import re
@@ -51,6 +52,12 @@ from .channel import Channel
 
 class ProtogenError(Exception):
     """protoc failed or the descriptor set is unusable."""
+
+
+# generated-module content seen per module name: recompiling a *modified*
+# proto under the same filename must not silently hand back the first
+# compile's stale classes (it would also mask descriptor-pool conflicts)
+_COMPILED_SHA: Dict[str, str] = {}
 
 
 class ServiceSpec(NamedTuple):
@@ -174,17 +181,54 @@ def compile_protos(*protos: str, includes: tuple = ()) -> ProtoPackage:
                 "-", "_"
             ) + "_pb2"
             mod_path = os.path.join(tmp, fd.name[: -len(".proto")] + "_pb2.py")
-            if os.path.exists(mod_path) and mod_name not in sys.modules:
-                spec = importlib.util.spec_from_file_location(mod_name, mod_path)
-                module = importlib.util.module_from_spec(spec)
-                # registered BEFORE exec so sibling _pb2 imports resolve
-                sys.modules[mod_name] = module
-                try:
-                    spec.loader.exec_module(module)
-                except Exception:
-                    del sys.modules[mod_name]
-                    raise
-                modules[mod_name] = module
+            if os.path.exists(mod_path):
+                with open(mod_path, "rb") as f:
+                    sha = hashlib.sha256(f.read()).hexdigest()
+                if mod_name in sys.modules:
+                    prev = _COMPILED_SHA.get(mod_name)
+                    if prev is None:
+                        # loaded outside compile_protos (e.g. an installed
+                        # _pb2): trust it only if its descriptor bytes match
+                        # what protoc just generated
+                        loaded = sys.modules[mod_name]
+                        ser = getattr(
+                            getattr(loaded, "DESCRIPTOR", None),
+                            "serialized_pb",
+                            None,
+                        )
+                        if ser != fd.SerializeToString():
+                            raise ProtogenError(
+                                f"module {mod_name!r} is already loaded with "
+                                f"a different descriptor than {fd.name!r} "
+                                "compiles to; rename the file or restart — "
+                                "protobuf's descriptor pool cannot hold two "
+                                "versions of one file"
+                            )
+                        _COMPILED_SHA[mod_name] = sha
+                    elif prev != sha:
+                        raise ProtogenError(
+                            f"proto {fd.name!r} changed since it was first "
+                            f"compiled in this process (module {mod_name!r} "
+                            "is already loaded with different contents); "
+                            "rename the file or restart the process — "
+                            "protobuf's descriptor pool cannot hold two "
+                            "versions of one file"
+                        )
+                    modules[mod_name] = sys.modules[mod_name]
+                else:
+                    spec = importlib.util.spec_from_file_location(
+                        mod_name, mod_path
+                    )
+                    module = importlib.util.module_from_spec(spec)
+                    # registered BEFORE exec so sibling _pb2 imports resolve
+                    sys.modules[mod_name] = module
+                    try:
+                        spec.loader.exec_module(module)
+                    except Exception:
+                        del sys.modules[mod_name]
+                        raise
+                    _COMPILED_SHA[mod_name] = sha
+                    modules[mod_name] = module
             elif mod_name in sys.modules:
                 modules[mod_name] = sys.modules[mod_name]
 

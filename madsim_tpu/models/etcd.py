@@ -46,7 +46,7 @@ Design notes:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -455,8 +455,22 @@ def _init(cfg: EtcdConfig, key):
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
-def workload(cfg: EtcdConfig = EtcdConfig()) -> Workload:
-    """Build the engine Workload for an etcd sweep configuration."""
+def workload(cfg: EtcdConfig = None) -> Workload:
+    """Build (memoized) the engine Workload for a sweep config."""
+    if cfg is None:  # normalize BEFORE the cache: lru_cache keys on
+        cfg = EtcdConfig()  # the raw argument tuple, () != (cfg,)
+    return _workload(cfg)
+
+
+@lru_cache(maxsize=None)
+def _workload(cfg: EtcdConfig) -> Workload:
+    """Build the engine Workload for an etcd sweep configuration.
+
+    Memoized per config: the engine's jit caches key on the Workload's
+    function identities (engine/core.py _drive static args), so equal-
+    but-distinct Workloads would silently recompile the sweep program
+    (~16 s). Same config -> same Workload object -> cache hit.
+    """
     return Workload(
         init=partial(_init, cfg),
         handle=partial(_handle, cfg),
@@ -500,6 +514,7 @@ sweep_summary = _common.make_sweep_summary(
         ("keys_expired", lambda f: jnp.sum(f.wstate.keys_expired)),
         ("partitions", lambda f: jnp.sum(f.wstate.parts)),
         ("final_rev", lambda f: jnp.sum(f.wstate.rev)),
+        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
         ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
     )
 )

@@ -42,7 +42,7 @@ Design notes (shared with models/raft.py):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -493,8 +493,22 @@ def _init(cfg: KafkaConfig, key):
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
-def workload(cfg: KafkaConfig = KafkaConfig()) -> Workload:
-    """Build the engine Workload for a Kafka sweep configuration."""
+def workload(cfg: KafkaConfig = None) -> Workload:
+    """Build (memoized) the engine Workload for a sweep config."""
+    if cfg is None:  # normalize BEFORE the cache: lru_cache keys on
+        cfg = KafkaConfig()  # the raw argument tuple, () != (cfg,)
+    return _workload(cfg)
+
+
+@lru_cache(maxsize=None)
+def _workload(cfg: KafkaConfig) -> Workload:
+    """Build the engine Workload for a Kafka sweep configuration.
+
+    Memoized per config: the engine's jit caches key on the Workload's
+    function identities (engine/core.py _drive static args), so equal-
+    but-distinct Workloads would silently recompile the sweep program
+    (~16 s). Same config -> same Workload object -> cache hit.
+    """
     return Workload(
         init=partial(_init, cfg),
         handle=partial(_handle, cfg),
@@ -537,6 +551,7 @@ sweep_summary = _common.make_sweep_summary(
         ("flushes", lambda f: jnp.sum(f.wstate.flushes)),
         ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
         ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
+        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
         ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
     )
 )

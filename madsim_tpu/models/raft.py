@@ -42,7 +42,7 @@ Design notes:
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -447,7 +447,8 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
         _pay(src, M_VOTE_GRANT, dst, mterm),
         _pay(src, M_APPEND_RSP, dst, term_d, ap_success, ap_match),
     )
-    send_reply = (grant | is_ap) & live & rdeliver
+    attempt_reply = (grant | is_ap) & live
+    send_reply = attempt_reply & rdeliver
     extra_time = jnp.where(won, now + cfg.heartbeat_ns, rt)
     extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
     extra_pay = jnp.where(won, _pay(dst, get1(w2.lepoch, dst)), reply_pay)
@@ -462,8 +463,11 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
         (extra_time, extra_kind, extra_pay, extra_on),
         (now + retimeout, K_ELECTION, _pay(dst, tgen_d), demoted),
     )
+    # sent counts every attempted reply (like the broadcast path, which
+    # counts all N-1 regardless of the link test); delivered only those
+    # that passed the link test
     w2 = w2._replace(
-        msgs_sent=w2.msgs_sent + sent + jnp.where(send_reply, 1, 0),
+        msgs_sent=w2.msgs_sent + sent + jnp.where(attempt_reply, 1, 0),
         msgs_delivered=w2.msgs_delivered + delivered + jnp.where(send_reply, 1, 0),
     )
     return w2, emits
@@ -628,8 +632,22 @@ def _init(cfg: RaftConfig, key):
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
-def workload(cfg: RaftConfig = RaftConfig()) -> Workload:
-    """Build the engine Workload for a Raft sweep configuration."""
+def workload(cfg: RaftConfig = None) -> Workload:
+    """Build (memoized) the engine Workload for a sweep config."""
+    if cfg is None:  # normalize BEFORE the cache: lru_cache keys on
+        cfg = RaftConfig()  # the raw argument tuple, () != (cfg,)
+    return _workload(cfg)
+
+
+@lru_cache(maxsize=None)
+def _workload(cfg: RaftConfig) -> Workload:
+    """Build the engine Workload for a Raft sweep configuration.
+
+    Memoized per config: the engine's jit caches key on the Workload's
+    function identities (engine/core.py _drive static args), so equal-
+    but-distinct Workloads would silently recompile the sweep program
+    (~16 s). Same config -> same Workload object -> cache hit.
+    """
     return Workload(
         init=partial(_init, cfg),
         handle=partial(_handle, cfg),
@@ -670,6 +688,7 @@ sweep_summary = _common.make_sweep_summary(
         ("accepted_cmds", lambda f: jnp.sum(f.wstate.accepted_cmds)),
         ("cmd_giveups", lambda f: jnp.sum(f.wstate.cmd_giveups)),
         ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
+        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
         ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
     )
 )

@@ -49,7 +49,7 @@ Design notes (shared with models/kafka.py):
 
 from __future__ import annotations
 
-from functools import partial
+from functools import lru_cache, partial
 from typing import NamedTuple
 
 import jax
@@ -583,8 +583,22 @@ def _init(cfg: S3Config, key):
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
-def workload(cfg: S3Config = S3Config()) -> Workload:
-    """Build the engine Workload for an S3 sweep configuration."""
+def workload(cfg: S3Config = None) -> Workload:
+    """Build (memoized) the engine Workload for a sweep config."""
+    if cfg is None:  # normalize BEFORE the cache: lru_cache keys on
+        cfg = S3Config()  # the raw argument tuple, () != (cfg,)
+    return _workload(cfg)
+
+
+@lru_cache(maxsize=None)
+def _workload(cfg: S3Config) -> Workload:
+    """Build the engine Workload for an S3 sweep configuration.
+
+    Memoized per config: the engine's jit caches key on the Workload's
+    function identities (engine/core.py _drive static args), so equal-
+    but-distinct Workloads would silently recompile the sweep program
+    (~16 s). Same config -> same Workload object -> cache hit.
+    """
     return Workload(
         init=partial(_init, cfg),
         handle=partial(_handle, cfg),
@@ -623,6 +637,7 @@ sweep_summary = _common.make_sweep_summary(
         ("upload_restarts", lambda f: jnp.sum(f.wstate.upload_restarts)),
         ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
         ("ops_done", lambda f: jnp.sum(f.wstate.ops_done)),
+        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
         ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
     )
 )

@@ -36,6 +36,12 @@ _EXTRA_TYPES: Dict[str, type] = {}
 def register(cls: type) -> type:
     """Explicitly allow a non-Request class on the wire (decorator-friendly).
     Its instances are encoded as their ``__dict__`` of plain data."""
+    if getattr(cls, "__dictoffset__", 0) == 0:
+        raise CodecError(
+            f"cannot register {cls.__qualname__}: its instances have no "
+            "__dict__ (__slots__ class?) — the codec round-trips objects "
+            "through their instance dict"
+        )
     _EXTRA_TYPES[f"{cls.__module__}::{cls.__qualname__}"] = cls
     return cls
 
@@ -96,9 +102,15 @@ def _enc(obj: Any, out: bytearray, depth: int) -> None:
         cls = type(obj)
         name = f"{cls.__module__}::{cls.__qualname__}"
         _lookup(name)  # refuse to *encode* unregistered types too
+        fields = getattr(obj, "__dict__", None)
+        if fields is None:
+            raise CodecError(
+                f"cannot encode {cls.__qualname__}: instance has no "
+                "__dict__ (__slots__ class?)"
+            )
         raw = name.encode()
         out += _OBJ + struct.pack(">I", len(raw)) + raw
-        _enc(dict(obj.__dict__), out, depth + 1)
+        _enc(dict(fields), out, depth + 1)
 
 
 class _Reader:

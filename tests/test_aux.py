@@ -5,6 +5,7 @@ import json
 import logging
 
 import jax.numpy as jnp
+import numpy as np
 import pytest
 
 import madsim_tpu as ms
@@ -239,3 +240,28 @@ def test_resumable_chunked_sweep(tmp_path, monkeypatch):
         checkpoint.run_sweep_chunked_resumable(
             wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=-1
         )
+
+    # a non-contiguous seed vector sharing a chunk's endpoints must not
+    # reuse that chunk's summary (guard hashes the full seed array)
+    shuffled = np.asarray(seeds).copy()
+    shuffled[1], shuffled[2] = shuffled[2], shuffled[1]
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpoint.run_sweep_chunked_resumable(
+            wl,
+            ecfg,
+            jnp.asarray(shuffled),
+            raft.sweep_summary,
+            d,
+            chunk_size=8,
+        )
+
+    # a pre-sha legacy record (endpoints + fingerprint only) still loads
+    legacy = json.loads(files[0].read_text())
+    del legacy["seeds_sha256"]
+    files[0].write_text(json.dumps(legacy))
+    assert (
+        checkpoint.run_sweep_chunked_resumable(
+            wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=8
+        )
+        == totals
+    )

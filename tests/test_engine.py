@@ -142,6 +142,31 @@ def test_raft_sweep_elects_leaders(raft_final):
     # within 3 virtual seconds nearly every 150-300ms-timeout cluster elects
     assert s["no_leader_seeds"] == 0
     assert s["events_total"] > 32 * 50
+    # sent counts attempts, delivered counts link-test passes
+    assert s["msgs_sent"] >= s["msgs_delivered"] > 0
+
+
+def test_workload_memoized_per_config():
+    """Equal configs must yield the SAME Workload object: _drive's jit
+    cache keys on the Workload's partials by identity, so an equal-but-
+    distinct Workload silently recompiles the whole sweep (~16 s)."""
+    from madsim_tpu.models import etcd, kafka, s3
+
+    assert raft.workload(SMALL) is raft.workload(
+        raft.RaftConfig(**SMALL._asdict())
+    )
+    for mod, cfg_cls in (
+        (kafka, kafka.KafkaConfig),
+        (etcd, etcd.EtcdConfig),
+        (s3, s3.S3Config),
+    ):
+        assert mod.workload(cfg_cls()) is mod.workload(cfg_cls())
+        # default-arg call normalizes to the same cache key
+        assert mod.workload() is mod.workload(cfg_cls())
+    # a different config still gets its own workload
+    assert raft.workload(SMALL) is not raft.workload(
+        raft.RaftConfig(**{**SMALL._asdict(), "crashes": SMALL.crashes + 1})
+    )
 
 
 def test_raft_all_seeds_terminate(raft_final):

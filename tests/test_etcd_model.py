@@ -26,6 +26,8 @@ def test_healthy_sweep_quiet_and_progresses():
     assert s["expiries"] > 0 and s["keys_expired"] > 0
     assert s["overflow_seeds"] == 0
     assert s["queue_high_water"] <= ECFG.queue_capacity
+    # sent counts attempts, delivered counts link-test passes
+    assert s["msgs_sent"] >= s["msgs_delivered"] > 0
 
 
 def test_skip_expiry_bug_is_caught():

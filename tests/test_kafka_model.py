@@ -34,6 +34,8 @@ def test_healthy_sweep_quiet_and_progresses():
     # bounded structures stayed bounded
     assert s["overflow_seeds"] == 0 and s["log_overflow_seeds"] == 0
     assert s["queue_high_water"] <= ECFG.queue_capacity
+    # sent counts attempts, delivered counts link-test passes
+    assert s["msgs_sent"] >= s["msgs_delivered"] > 0
 
 
 def test_consumers_only_see_durable_contiguous_stream():

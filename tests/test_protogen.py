@@ -122,6 +122,29 @@ def test_unknown_service_and_missing_method_error():
                 return None
 
 
+def test_modified_proto_same_filename_errors_not_stale():
+    """Recompiling a *changed* proto under the same filename must raise,
+    not silently hand back the first compile's message classes (the
+    descriptor pool can't hold two versions of one file anyway)."""
+    pkg = _compile()  # seeds the module cache with echotest.proto
+    assert "n" in {
+        f.name
+        for f in pkg.messages["echotest.EchoRequest"].DESCRIPTOR.fields
+    }
+    changed = PROTO.replace("int32 n = 2;", "int32 n = 2; bool extra = 3;")
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "echotest.proto")
+        with open(path, "w") as f:
+            f.write(changed)
+        with pytest.raises(grpc.ProtogenError, match="changed since"):
+            grpc.compile_protos(path)
+    # an unchanged recompile still reuses the cached module quietly
+    pkg2 = _compile()
+    assert pkg2.messages["echotest.EchoRequest"] is pkg.messages[
+        "echotest.EchoRequest"
+    ]
+
+
 def test_bad_proto_reports_protoc_error():
     with tempfile.TemporaryDirectory() as d:
         path = os.path.join(d, "bad.proto")

@@ -263,6 +263,30 @@ def test_codec_refuses_unregistered_types():
         codec.loads(good + b"Z")
 
 
+def test_codec_rejects_slots_classes_loudly():
+    """A __slots__ class can't round-trip through the instance-dict
+    protocol; the failure must be a CodecError at register/encode time,
+    not a raw AttributeError escaping dumps."""
+    from madsim_tpu.real import codec
+
+    class Slotted:
+        __slots__ = ("x",)
+
+    with pytest.raises(codec.CodecError, match="__dict__"):
+        codec.register(Slotted)
+
+    # a slots class that slipped past registration (e.g. a Request
+    # subclass) still fails as a codec-level error on encode
+    codec._EXTRA_TYPES[f"{Slotted.__module__}::{Slotted.__qualname__}"] = Slotted
+    try:
+        s = Slotted()
+        s.x = 1
+        with pytest.raises(codec.CodecError, match="__dict__"):
+            codec.dumps(s)
+    finally:
+        del codec._EXTRA_TYPES[f"{Slotted.__module__}::{Slotted.__qualname__}"]
+
+
 def test_udp_endpoint_drops_hostile_frames():
     """A malformed/hostile datagram is dropped like line noise; the
     endpoint keeps serving."""

@@ -36,6 +36,8 @@ def test_healthy_sweep_quiet_and_progresses():
     # bounded structures stayed bounded
     assert s["overflow_seeds"] == 0
     assert s["queue_high_water"] <= ECFG.queue_capacity
+    # sent counts attempts, delivered counts link-test passes
+    assert s["msgs_sent"] >= s["msgs_delivered"] > 0
 
 
 def test_durability_and_version_invariants_in_correct_mode():
