@@ -12,6 +12,10 @@ crash/restart fault injection, 3 virtual seconds per seed), with:
 - ``recovery_e2e``: config #5's determinism half — a sweep interrupted
   at 300 steps, checkpointed to .npz, restored, resumed, and verified
   bit-identical to the uninterrupted run;
+- ``cross_backend``: the hardware bit-parity contract, self-verified —
+  a 4096-seed sweep on the TPU vs the same seeds on the CPU backend,
+  every EngineState leaf compared, plus one CPU traced replay against
+  its TPU sweep lane;
 - ``kafka``: BASELINE config #4 as a second workload line (10k-seed
   broker crash/restart sweep with the acked-loss checker quiet);
 - ``etcd``: BASELINE config #2 (8k-seed 3-node KV + lease sweep with
@@ -155,6 +159,58 @@ def bench_recovery(wl, raft_mod):
     return {"seeds": 4096, "interrupted_at_step": 300, "bit_identical": identical}
 
 
+def _leaf_np(a):
+    """Host array for comparison; typed PRNG keys via their raw words."""
+    if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+        a = jax.random.key_data(a)
+    return np.asarray(a)
+
+
+def bench_cross_backend(wl, ecfg):
+    """THE framework contract, machine-checked on hardware every round:
+    a TPU sweep and a CPU sweep of the same seeds are bit-identical on
+    every EngineState leaf, and the single-seed traced replay (the
+    debugging path, engine/core.run_traced) lands on the same final
+    state as the batched sweep lane. Ref analogue: determinism checking
+    as a first-class harness feature (madsim/src/sim/runtime/mod.rs:
+    178-202). Skipped (reported as such) when no second backend exists
+    — e.g. the whole process is already CPU-only."""
+    from madsim_tpu.engine import core
+
+    if jax.default_backend() == "cpu":
+        return {"skipped": "single-backend process (cpu only)"}
+    cpu = jax.devices("cpu")[0]
+    seeds = _fresh(4096)
+    dev_final = core.run_sweep(wl, ecfg, seeds)
+    with jax.default_device(cpu):
+        cpu_final = core.run_sweep(wl, ecfg, jax.device_put(seeds, cpu))
+    dev_leaves, _ = jax.tree.flatten(dev_final)
+    cpu_leaves, _ = jax.tree.flatten(cpu_final)
+    leaves_equal = all(
+        np.array_equal(_leaf_np(a), _leaf_np(b))
+        for a, b in zip(dev_leaves, cpu_leaves)
+    )
+
+    # traced replay of one seed on CPU == that seed's sweep lane on TPU
+    replay_seed = int(np.asarray(seeds)[0])
+    with jax.default_device(cpu):
+        traced_final, _ = core.run_traced(wl, ecfg, replay_seed)
+    lane = jax.tree.map(lambda a: a[0], dev_final)
+    t_leaves, _ = jax.tree.flatten(traced_final)
+    l_leaves, _ = jax.tree.flatten(lane)
+    replay_equal = all(
+        np.array_equal(_leaf_np(a), _leaf_np(b))
+        for a, b in zip(t_leaves, l_leaves)
+    )
+    return {
+        "seeds": int(seeds.shape[0]),
+        "leaves": len(dev_leaves),
+        "leaves_equal": leaves_equal,
+        "traced_replay_seed": replay_seed,
+        "traced_replay_equal": replay_equal,
+    }
+
+
 def bench_etcd():
     """BASELINE config #2: 3-node KV + lease with partition injection."""
     from madsim_tpu.engine import core
@@ -219,6 +275,7 @@ def main() -> None:
     curve = bench_curve(wl, ecfg, raft)
     big = bench_100k(wl, ecfg, raft)
     recovery = bench_recovery(wl, raft)
+    cross = bench_cross_backend(wl, ecfg)
     kafka_line = bench_kafka()
     etcd_line = bench_etcd()
 
@@ -248,6 +305,7 @@ def main() -> None:
                 "batch_curve": curve,
                 "sweep_100k": big,
                 "recovery_e2e": recovery,
+                "cross_backend": cross,
                 "kafka": kafka_line,
                 "etcd": etcd_line,
                 "backend": jax.default_backend(),

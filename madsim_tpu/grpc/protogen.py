@@ -196,7 +196,14 @@ def compile_protos(*protos: str, includes: tuple = ()) -> ProtoPackage:
                             "serialized_pb",
                             None,
                         )
-                        if ser != fd.SerializeToString():
+                        # compare parsed messages, not bytes: a different
+                        # protoc release can serialize the same descriptor
+                        # with different bytes
+                        same = ser is not None and (
+                            descriptor_pb2.FileDescriptorProto.FromString(ser)
+                            == fd
+                        )
+                        if not same:
                             raise ProtogenError(
                                 f"module {mod_name!r} is already loaded with "
                                 f"a different descriptor than {fd.name!r} "

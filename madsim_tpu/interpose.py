@@ -20,11 +20,14 @@ perf_counter_ns}``, ``threading.Thread.start`` (blocked in sim unless
 allowed), ``os.cpu_count`` (reports the node's configured cores), and
 ``datetime.datetime`` / ``datetime.date`` (module attributes swapped for
 sim-aware subclasses whose ``now``/``utcnow``/``today`` read the virtual
-clock; the C methods themselves are unpatchable).  Remaining gap: code
-that ran ``from datetime import datetime`` *before* the sim started holds
-the original class — its ``now()`` reads the OS clock.  Sim-aware
-``datetime.now()`` returns UTC-based naive time so results don't depend
-on the host machine's timezone database.
+clock; the C methods themselves are unpatchable).  Pre-existing aliases
+— code that ran ``from datetime import datetime`` *before* the sim
+started — are rebound by scanning every loaded module's dict for
+attributes holding the real classes (freezegun's approach) and restored
+on uninstall; the remaining (documented) hole is non-module references
+captured before install, e.g. a class attribute or closure cell holding
+the real class.  Sim-aware ``datetime.now()`` returns UTC-based naive
+time so results don't depend on the host machine's timezone database.
 """
 
 from __future__ import annotations
@@ -40,6 +43,14 @@ from .context import try_current_handle
 _lock = threading.Lock()
 _install_count = 0
 _originals: dict = {}
+# (module, attr, real_class) triples rebound by the alias scan, for restore
+_rebound_aliases: list = []
+# alias-scan memo: module name -> id() at last scan, and the discovered
+# (attr, kind) sites per module — repeat installs only rescan modules
+# that appeared (or were reloaded) since, instead of every attribute of
+# every module (measured ~3 ms/scan; installs happen per block_on)
+_scanned_ids: dict = {}
+_alias_sites: dict = {}
 
 
 class _SimRandomDispatch:
@@ -222,6 +233,74 @@ def _make_datetime_classes():
     return SimDateTime, SimDate
 
 
+def _rebind_datetime_aliases(sim_datetime, sim_date) -> None:
+    """Close the pre-import alias hole: rebind every loaded module's
+    attributes that hold the REAL ``datetime``/``date`` classes (bound by
+    ``from datetime import datetime`` before the sim started) to the
+    sim-aware subclasses, recording each for restore at uninstall.
+
+    freezegun's module-scan approach; the libc interposition it stands in
+    for (sim/time/system_time.rs:4-113) has no such hole because it
+    patches below the class, at ``clock_gettime``. Residual (documented)
+    gaps: non-module references captured pre-install (class attributes,
+    closure cells), and attributes *assigned into an already-imported
+    module's dict* between sims — the memo below rescans a module only
+    when it first appears in (or is reloaded into) ``sys.modules``,
+    which covers the real flow (``from datetime import datetime`` runs
+    at module import)."""
+    import sys
+
+    real_datetime = _originals["datetime.datetime"]
+    real_date = _originals["datetime.date"]
+    real_by_kind = {"datetime": real_datetime, "date": real_date}
+    sim_by_kind = {"datetime": sim_datetime, "date": sim_date}
+
+    # pass 1: discover sites in modules not seen (or reloaded) since the
+    # last scan; already-scanned modules are skipped entirely
+    for name, mod in list(sys.modules.items()):
+        if mod is None or name in ("datetime", __name__):
+            continue
+        if _scanned_ids.get(name) == id(mod):
+            continue
+        sites = []
+        try:
+            items = list(vars(mod).items())
+        except Exception:
+            items = []  # lazy-loader modules may raise on dict access
+        for attr, val in items:
+            if val is real_datetime:
+                sites.append((attr, "datetime"))
+            elif val is real_date:
+                sites.append((attr, "date"))
+        _scanned_ids[name] = id(mod)
+        if sites:
+            _alias_sites[name] = sites
+        else:
+            _alias_sites.pop(name, None)
+
+    # pass 2: rebind every known site that still holds the real class
+    for name, sites in list(_alias_sites.items()):
+        mod = sys.modules.get(name)
+        if mod is None:
+            continue
+        for attr, kind in sites:
+            try:
+                if getattr(mod, attr, None) is real_by_kind[kind]:
+                    setattr(mod, attr, sim_by_kind[kind])
+                    _rebound_aliases.append((mod, attr, real_by_kind[kind]))
+            except Exception:
+                continue  # read-only module attribute; leave it
+
+
+def _restore_datetime_aliases() -> None:
+    for mod, attr, real_cls in _rebound_aliases:
+        try:
+            setattr(mod, attr, real_cls)
+        except Exception:
+            pass
+    _rebound_aliases.clear()
+
+
 def _sim_cpu_count() -> Any:
     """Inside a sim task, report the node's configured cores — the
     analogue of the reference faking ``available_parallelism`` via
@@ -286,6 +365,7 @@ def _install() -> None:
     _t.perf_counter_ns = _make_clock("time.perf_counter_ns", "mono", ns=True)
     threading.Thread.start = _sim_thread_start  # type: ignore[method-assign]
     _dt.datetime, _dt.date = _make_datetime_classes()
+    _rebind_datetime_aliases(_dt.datetime, _dt.date)
 
 
 def _uninstall() -> None:
@@ -293,6 +373,7 @@ def _uninstall() -> None:
     import random as _r
     import time as _t
 
+    _restore_datetime_aliases()
     _dt.datetime = _originals["datetime.datetime"]
     _dt.date = _originals["datetime.date"]
 

@@ -38,6 +38,30 @@ def merge_summaries(totals: dict, summary: dict) -> dict:
     return totals
 
 
+def memoized_workload(cfg_cls):
+    """Decorator for a model's ``workload(cfg)`` constructor: memoize per
+    config (configs are hashable NamedTuples), normalizing an omitted
+    argument to ``cfg_cls()`` BEFORE the cache so ``workload()`` and
+    ``workload(cfg_cls())`` share one entry.
+
+    Why: the engine's jit caches (engine/core.py ``_drive`` static args)
+    key on the Workload's ``partial``s by identity, so an equal-but-
+    distinct Workload silently recompiles the whole sweep program
+    (~16 s). Same config -> same Workload object -> cache hit."""
+    from functools import lru_cache, wraps
+
+    def deco(build):
+        cached = lru_cache(maxsize=None)(build)
+
+        @wraps(build)
+        def workload(cfg=None):
+            return cached(cfg if cfg is not None else cfg_cls())
+
+        return workload
+
+    return deco
+
+
 def make_sweep_summary(
     fields: Tuple[Tuple[str, Callable], ...]
 ) -> Callable[[object], dict]:

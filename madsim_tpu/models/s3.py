@@ -49,7 +49,7 @@ Design notes (shared with models/kafka.py):
 
 from __future__ import annotations
 
-from functools import lru_cache, partial
+from functools import partial
 from typing import NamedTuple
 
 import jax
@@ -583,22 +583,10 @@ def _init(cfg: S3Config, key):
     return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
 
 
+@_common.memoized_workload(S3Config)
 def workload(cfg: S3Config = None) -> Workload:
-    """Build (memoized) the engine Workload for a sweep config."""
-    if cfg is None:  # normalize BEFORE the cache: lru_cache keys on
-        cfg = S3Config()  # the raw argument tuple, () != (cfg,)
-    return _workload(cfg)
-
-
-@lru_cache(maxsize=None)
-def _workload(cfg: S3Config) -> Workload:
-    """Build the engine Workload for an S3 sweep configuration.
-
-    Memoized per config: the engine's jit caches key on the Workload's
-    function identities (engine/core.py _drive static args), so equal-
-    but-distinct Workloads would silently recompile the sweep program
-    (~16 s). Same config -> same Workload object -> cache hit.
-    """
+    """Build the engine Workload for an S3 sweep configuration
+    (memoized per config — see _common.memoized_workload)."""
     return Workload(
         init=partial(_init, cfg),
         handle=partial(_handle, cfg),

@@ -42,7 +42,11 @@ def test_sharded_sweep_matches_unsharded():
 
 
 def test_cross_backend_bit_exact():
-    """CPU vs session-default backend (TPU when tunneled): identical."""
+    """CPU vs session-default backend: identical. NOTE: under pytest the
+    conftest forces a CPU-only process, so this compares CPU to CPU and
+    only proves the comparison machinery; the REAL hardware check runs
+    in bench.py (bench_cross_backend, emitted as ``cross_backend`` in
+    every BENCH_r*.json) where the default backend is the TPU."""
     wl = raft.workload(CFG)
     seeds = jnp.arange(8, dtype=jnp.int64)
     default = ecore.run_sweep(wl, ECFG, seeds)

@@ -94,6 +94,51 @@ def test_datetime_interposed():
     assert datetime.datetime is real_cls  # restored outside the sim
 
 
+def test_datetime_pre_import_alias_rebound():
+    """An alias bound by ``from datetime import datetime`` BEFORE the sim
+    starts must still read the virtual clock inside the sim (the install
+    scan rebinds module attributes holding the real classes, freezegun-
+    style) and be the real class again afterwards. Modeled as the real
+    flow: a module imported — with its import-time aliases — before any
+    Runtime exists (earlier sims in this process notwithstanding: a NEW
+    sys.modules entry is always scanned)."""
+    import sys
+    import types
+
+    import datetime as real_dt
+
+    # simulate `import user_mod` where user_mod.py did
+    # `from datetime import datetime, date` at import time
+    user_mod = types.ModuleType("fake_user_mod_alias_test")
+    user_mod.pre_datetime = real_dt.datetime
+    user_mod.pre_date = real_dt.date
+    sys.modules[user_mod.__name__] = user_mod
+
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+
+        async def main():
+            a = user_mod.pre_datetime.now()
+            await ms.sleep(120.0)
+            b = user_mod.pre_datetime.now()
+            assert 120.0 <= (b - a).total_seconds() < 120.01  # virtual
+            # compare against b (same instant) — a is 120 virtual seconds
+            # earlier and could sit on the far side of midnight
+            assert user_mod.pre_date.today() == b.date()
+            return a.isoformat()
+
+        return rt.block_on(main())
+
+    try:
+        assert run(31) == run(31)  # deterministic
+        assert run(31) != run(32)  # seed-dependent (randomized base time)
+        # restored after the sim: the alias is the real class again
+        assert user_mod.pre_datetime is real_dt.datetime
+        assert user_mod.pre_date is real_dt.date
+    finally:
+        del sys.modules[user_mod.__name__]
+
+
 def test_datetime_isinstance_inside_sim():
     """The swapped classes must not change isinstance/issubclass dispatch:
     a sim datetime is an instance of datetime.date (datetime ⊂ date), and
