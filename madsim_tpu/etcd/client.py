@@ -204,20 +204,26 @@ class Client:
     def __init__(self, endpoints: List[str]):
         self._endpoints = endpoints
 
-    @staticmethod
+    @classmethod
     async def connect(
+        cls,
         endpoints: "str | Sequence[str]",
         options: Optional[ConnectOptions] = None,
     ) -> "Client":
         if isinstance(endpoints, str):
             endpoints = [endpoints]
-        return Client(list(endpoints))
+        return cls(list(endpoints))
 
     # -- plumbing ----------------------------------------------------------
 
+    @staticmethod
+    def _randint(n: int) -> int:
+        """Endpoint-balance draw — sim RNG; real/etcd.py overrides."""
+        return msrand.gen_range(0, n)
+
     def _pick(self) -> str:
         eps = self._endpoints
-        return eps[msrand.gen_range(0, len(eps))] if len(eps) > 1 else eps[0]
+        return eps[self._randint(len(eps))] if len(eps) > 1 else eps[0]
 
     async def _open(self):
         return await connect1_ephemeral(self._pick())
@@ -230,6 +236,8 @@ class Client:
             rsp = await rx.recv()
         except (BrokenPipeError, ConnectionResetError) as e:
             raise Status.unavailable(f"etcd transport error: {e}") from None
+        finally:
+            rx.close()  # one-shot exchange; frees the real-mode socket
         if rsp is None:
             raise Status.unavailable("etcd connection closed")
         kind, payload = rsp
@@ -343,6 +351,7 @@ class ElectionClient:
             raise Status.unavailable(str(e)) from None
         finally:
             tx.close()
+            rx.close()  # exchange complete; frees the real-mode socket
         if rsp is None:
             raise Status.unavailable("etcd connection closed")
         kind, payload = rsp
@@ -430,12 +439,17 @@ class WatchClient:
 
     async def watch(self, key, prefix: bool = False) -> WatchStream:
         tx, rx = await self._c._stream(("watch", _b(key), prefix))
-        head = await rx.recv()
-        if head is None:
-            raise Status.unavailable("etcd connection closed")
-        kind, payload = head
-        if kind == "err":
-            raise payload
+        try:
+            head = await rx.recv()
+            if head is None:
+                raise Status.unavailable("etcd connection closed")
+            kind, payload = head
+            if kind == "err":
+                raise payload
+        except BaseException:
+            tx.close()
+            rx.close()  # failed exchange must not leak the real-mode socket
+            raise
         return WatchStream(tx, rx)
 
 

@@ -27,6 +27,8 @@ from .service import (
 
 
 class SimServerBuilder:
+    _server_cls: "type | None" = None  # real/etcd.py overrides
+
     def __init__(self) -> None:
         self._timeout_rate = 0.0
         self._service: Optional[EtcdService] = None
@@ -45,7 +47,9 @@ class SimServerBuilder:
         return self
 
     async def serve(self, addr: "str | tuple") -> None:
-        server = SimServer(self._service or EtcdService(), self._timeout_rate)
+        server = (self._server_cls or SimServer)(
+            self._service or EtcdService(), self._timeout_rate
+        )
         await server.serve(addr)
 
 
@@ -54,20 +58,37 @@ class SimServer:
     def builder() -> SimServerBuilder:
         return SimServerBuilder()
 
+    # executor bindings as class attributes so the real-mode twin
+    # (real/etcd.py) can rebind them to asyncio + real randomness while
+    # reusing the whole request dispatcher — the sim/std split of
+    # madsim-etcd-client/src/lib.rs
+    _spawn = staticmethod(mstask.spawn)
+    _sleep = staticmethod(mstime.sleep)
+    _rand01 = staticmethod(msrand.random)
+    _uniform = staticmethod(msrand.uniform)
+
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        return await NetEndpoint.bind(addr)
+
     def __init__(self, service: EtcdService, timeout_rate: float = 0.0):
         self.service = service
         self.timeout_rate = timeout_rate
+        #: set once the listener is bound (port-0 discovery, real mode)
+        self.bound_addr: "Optional[tuple]" = None
 
     async def serve(self, addr: "str | tuple") -> None:
-        ep = await NetEndpoint.bind(addr)
-        mstask.spawn(self._tick_loop(), name="etcd-tick")
+        ep = await self._bind(addr)
+        local = getattr(ep, "local_addr", None)
+        self.bound_addr = local() if callable(local) else None
+        self._spawn(self._tick_loop(), name="etcd-tick")
         while True:
             tx, rx, _src = await ep.accept1()
-            mstask.spawn(self._serve_conn(tx, rx), name="etcd-conn")
+            self._spawn(self._serve_conn(tx, rx), name="etcd-conn")
 
     async def _tick_loop(self) -> None:
         while True:
-            await mstime.sleep(1.0)
+            await self._sleep(1.0)
             self.service.tick()
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
@@ -75,8 +96,8 @@ class SimServer:
             req = await rx.recv()
             if req is None:
                 return
-            if self.timeout_rate > 0 and msrand.random() < self.timeout_rate:
-                await mstime.sleep(msrand.uniform(5.0, 15.0))
+            if self.timeout_rate > 0 and self._rand01() < self.timeout_rate:
+                await self._sleep(self._uniform(5.0, 15.0))
                 await tx.send(("err", Status.unavailable("etcdserver: request timed out")))
                 return
             await self._handle(req, tx, rx)

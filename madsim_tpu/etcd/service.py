@@ -213,10 +213,15 @@ class Lease:
 
 
 class EventBus:
-    """Prefix-watch pub/sub (the reference's watcher EventBus)."""
+    """Prefix-watch pub/sub (the reference's watcher EventBus).
+
+    ``future_factory`` produces the one-shot wakeup cell watchers block on;
+    the default is the sim Future, and real mode (real/etcd.py) swaps in
+    ``asyncio`` futures so the same service runs on a real event loop."""
 
     def __init__(self) -> None:
         self._watchers: List[Tuple[bytes, bool, List[Event], List[Future]]] = []
+        self.future_factory = Future
 
     def subscribe(self, key: bytes, prefix: bool) -> "Watcher":
         entry = (key, prefix, [], [])
@@ -232,7 +237,8 @@ class EventBus:
                 queue.append(event)
                 waiters, futs[:] = futs[:], []
                 for f in waiters:
-                    f.set_result(None)
+                    if not f.done():  # asyncio futures raise if cancelled
+                        f.set_result(None)
 
 
 class Watcher:
@@ -243,7 +249,7 @@ class Watcher:
     async def next(self) -> Event:
         _key, _prefix, queue, futs = self._entry
         while not queue:
-            fut: Future = Future()
+            fut = self._bus.future_factory()
             futs.append(fut)
             await fut
         return queue.pop(0)

@@ -19,20 +19,28 @@ from .status import Status
 
 
 class Endpoint:
-    """Builder for one server address (tonic ``transport::Endpoint``)."""
+    """Builder for one server address (tonic ``transport::Endpoint``).
+
+    ``_channel_cls`` / ``_timeout`` / ``_timeout_error`` are overridden by
+    the real-mode twin (real/grpc.py) to bind the same builder surface to
+    asyncio + real sockets."""
+
+    _channel_cls: "type | None" = None  # defaults to Channel below
+    _timeout_fn = staticmethod(mstime.timeout)
+    _timeout_error: type = mstime.TimeoutError
 
     def __init__(self, uri: str):
         self.uri = uri
         self._timeout: Optional[float] = None
         self._connect_timeout: Optional[float] = None
 
-    @staticmethod
-    def from_static(uri: str) -> "Endpoint":
-        return Endpoint(uri)
+    @classmethod
+    def from_static(cls, uri: str) -> "Endpoint":
+        return cls(uri)
 
-    @staticmethod
-    def from_shared(uri: str) -> "Endpoint":
-        return Endpoint(uri)
+    @classmethod
+    def from_shared(cls, uri: str) -> "Endpoint":
+        return cls(uri)
 
     def timeout(self, seconds: float) -> "Endpoint":
         """Per-RPC timeout applied to every call on the channel
@@ -79,18 +87,19 @@ class Endpoint:
         ch = self.connect_lazy()
         try:
             if self._connect_timeout is not None:
-                tx, rx = await mstime.timeout(self._connect_timeout, ch._open(self._addr()))
+                tx, rx = await self._timeout_fn(self._connect_timeout, ch._open(self._addr()))
             else:
                 tx, rx = await ch._open(self._addr())
             tx.close()
-        except mstime.TimeoutError:
+            rx.close()
+        except self._timeout_error:
             raise Status.unavailable(f"connect timed out: {self.uri}") from None
         except (ConnectionError, OSError) as e:
             raise Status.unavailable(f"transport error: {e}") from None
         return ch
 
     def connect_lazy(self) -> "Channel":
-        return Channel([self])
+        return (self._channel_cls or Channel)([self])
 
 
 class Change:
@@ -117,15 +126,15 @@ class Channel:
             str(i): ep for i, ep in enumerate(endpoints)
         }
 
-    @staticmethod
-    def balance_list(endpoints: List[Endpoint]) -> "Channel":
-        return Channel(list(endpoints))
+    @classmethod
+    def balance_list(cls, endpoints: List[Endpoint]) -> "Channel":
+        return cls(list(endpoints))
 
-    @staticmethod
-    def balance_channel(capacity: int = 16) -> Tuple["Channel", "_BalanceSender"]:
+    @classmethod
+    def balance_channel(cls, capacity: int = 16) -> Tuple["Channel", "_BalanceSender"]:
         """Dynamic endpoint set: returns (channel, sender); feed the sender
         ``Change.insert/remove`` items (transport/channel.rs:335-359)."""
-        ch = Channel([])
+        ch = cls([])
         return ch, _BalanceSender(ch)
 
     @property
@@ -135,11 +144,16 @@ class Channel:
                 return ep._timeout
         return None
 
+    @staticmethod
+    def _randint(n: int) -> int:
+        """Balance draw — sim RNG here; real mode overrides with ``random``."""
+        return msrand.gen_range(0, n)
+
     def _pick(self) -> Endpoint:
         if not self._endpoints:
             raise Status.unavailable("no endpoints available")
         keys = sorted(self._endpoints)
-        key = keys[msrand.gen_range(0, len(keys))]
+        key = keys[self._randint(len(keys))]
         return self._endpoints[key]
 
     async def _open(self, addr: str):

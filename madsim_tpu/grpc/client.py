@@ -91,14 +91,24 @@ async def _feed(tx: Any, messages: Union[Iterable, AsyncIterable]) -> None:
 
 
 class Grpc:
-    """The generic caller; typed clients (service.py) wrap this."""
+    """The generic caller; typed clients (service.py) wrap this.
+
+    The executor bindings are class attributes so the real-mode twin
+    (real/grpc.py) can swap sim spawn/timeout for asyncio ones while
+    reusing every call shape unchanged — the analogue of the reference
+    compiling the same tonic surface with or without ``--cfg madsim``.
+    """
+
+    _spawn = staticmethod(mstask.spawn)
+    _timeout = staticmethod(mstime.timeout)
+    _timeout_error: type = mstime.TimeoutError
 
     def __init__(self, channel: Channel, interceptor: Optional[Interceptor] = None):
         self.channel = channel
         self.interceptor = interceptor
 
     def with_interceptor(self, f: Interceptor) -> "Grpc":
-        return Grpc(self.channel, f)
+        return type(self)(self.channel, f)  # keep real-mode subclass bindings
 
     def _prepare(self, request: Request) -> Request:
         if self.interceptor is not None:
@@ -115,20 +125,28 @@ class Grpc:
         except (ConnectionError, OSError) as e:
             raise Status.unavailable(f"transport error: {e}") from None
         try:
-            await tx.send((path, server_streaming, request))
-        except BrokenPipeError as e:
-            raise Status.unavailable(f"broken pipe: {e}") from None
-        if body is not None:
-            mstask.spawn(_feed(tx, body), name=f"grpc-feed {path}")
-        else:
+            try:
+                await tx.send((path, server_streaming, request))
+            except BrokenPipeError as e:
+                raise Status.unavailable(f"broken pipe: {e}") from None
+            if body is not None:
+                self._spawn(_feed(tx, body), name=f"grpc-feed {path}")
+            else:
+                tx.close()
+            try:
+                head = await rx.recv()
+            except ConnectionResetError as e:
+                raise Status.unavailable(str(e) or "connection reset") from None
+            if head is None:
+                raise Status.unavailable("connection closed before response")
+            return head, rx
+        except BaseException:
+            # error OR cancellation (e.g. a grpc-timeout cancelling this
+            # call mid-await): drop both halves so the real-mode socket is
+            # freed instead of leaking until GC
             tx.close()
-        try:
-            head = await rx.recv()
-        except ConnectionResetError as e:
-            raise Status.unavailable(str(e) or "connection reset") from None
-        if head is None:
-            raise Status.unavailable("connection closed before response")
-        return head, rx
+            rx.close()
+            raise
 
     async def _call_timeout(self, path: str, request: Request,
                             server_streaming: bool, body) -> Tuple[Any, Any]:
@@ -136,10 +154,10 @@ class Grpc:
         if timeout_s is None:
             return await self._call(path, request, server_streaming, body)
         try:
-            return await mstime.timeout(
+            return await self._timeout(
                 timeout_s, self._call(path, request, server_streaming, body)
             )
-        except mstime.TimeoutError:
+        except self._timeout_error:
             raise Status.cancelled("Timeout expired") from None
 
     @staticmethod
@@ -154,7 +172,10 @@ class Grpc:
     async def unary(self, path: str, request: Union[Request, Any]) -> Response:
         request = self._prepare(Request.wrap(request))
         head, rx = await self._call_timeout(path, request, False, None)
-        return self._unwrap(head)
+        try:
+            return self._unwrap(head)
+        finally:
+            rx.close()  # exchange complete; frees the real-mode socket
 
     async def client_streaming(
         self, path: str, messages: Union[Iterable, AsyncIterable],
@@ -162,15 +183,22 @@ class Grpc:
     ) -> Response:
         request = self._prepare(request or Request())
         head, rx = await self._call_timeout(path, request, False, messages)
-        return self._unwrap(head)
+        try:
+            return self._unwrap(head)
+        finally:
+            rx.close()
 
     async def server_streaming(
         self, path: str, request: Union[Request, Any]
     ) -> Streaming:
         request = self._prepare(Request.wrap(request))
         head, rx = await self._call_timeout(path, request, True, None)
-        self._unwrap(head)
-        return Streaming(rx)
+        try:
+            self._unwrap(head)
+        except BaseException:
+            rx.close()
+            raise
+        return Streaming(rx, close_at_end=True)
 
     async def streaming(
         self, path: str, messages: Union[Iterable, AsyncIterable],
@@ -178,5 +206,9 @@ class Grpc:
     ) -> Streaming:
         request = self._prepare(request or Request())
         head, rx = await self._call_timeout(path, request, True, messages)
-        self._unwrap(head)
-        return Streaming(rx)
+        try:
+            self._unwrap(head)
+        except BaseException:
+            rx.close()
+            raise
+        return Streaming(rx, close_at_end=True)

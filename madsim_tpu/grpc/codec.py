@@ -32,9 +32,22 @@ class Streaming:
     ``None`` at end of stream — the tonic API shape).
     """
 
-    def __init__(self, rx: Any):
+    def __init__(self, rx: Any, close_at_end: bool = False):
+        # close_at_end is set on CLIENT-side response streams only: once the
+        # stream finishes the whole exchange is over, so the receiver half
+        # can be dropped (in real mode this frees the TCP socket).  Server-
+        # side request streams share their connection with the pending
+        # reply, so they must NOT close it.
         self._rx = rx
         self._done = False
+        self._close_at_end = close_at_end
+
+    def _finish(self) -> None:
+        self._done = True
+        if self._close_at_end:
+            close = getattr(self._rx, "close", None)
+            if close is not None:
+                close()
 
     async def message(self) -> Optional[Any]:
         if self._done:
@@ -45,10 +58,10 @@ class Streaming:
             self._done = True
             raise Status.unavailable(str(e) or "connection reset") from None
         if msg is None or is_eos(msg):
-            self._done = True
+            self._finish()
             return None
         if is_err(msg):
-            self._done = True
+            self._finish()
             raise msg[1]
         return msg
 

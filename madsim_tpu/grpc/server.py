@@ -29,6 +29,8 @@ class Server:
 
 
 class ServerBuilder:
+    _router_cls: "type | None" = None  # real/grpc.py overrides
+
     def __init__(self) -> None:
         self._services: Dict[str, Any] = {}
 
@@ -50,18 +52,27 @@ class ServerBuilder:
     layer = _ignore
 
     def add_service(self, svc: Any) -> "Router":
-        return Router(self)._add(svc)
+        return (self._router_cls or Router)(self)._add(svc)
 
     def add_optional_service(self, svc: Optional[Any]) -> "Router":
-        router = Router(self)
+        router = (self._router_cls or Router)(self)
         return router._add(svc) if svc is not None else router
 
 
 class Router:
-    """Routes by service name (transport/server.rs:258-272)."""
+    """Routes by service name (transport/server.rs:258-272).
+
+    ``_spawn`` and the serve/accept loop are the only executor-bound
+    pieces; real/grpc.py subclasses override them to serve the SAME
+    service classes over real TCP."""
+
+    _spawn = staticmethod(mstask.spawn)
 
     def __init__(self, builder: ServerBuilder):
         self._services: Dict[str, Any] = dict(builder._services)
+        #: set once the listener is bound; lets callers serve on port 0
+        #: and discover the address (handy in real mode)
+        self.bound_addr: Optional[tuple] = None
 
     def _add(self, svc: Any) -> "Router":
         self._services[service_name(svc)] = svc
@@ -73,13 +84,21 @@ class Router:
     async def serve(self, addr: "str | tuple") -> None:
         await self.serve_with_shutdown(addr, None)
 
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        """Listener factory (anything with accept1/close) — the one
+        transport-bound step; real mode binds a StreamListener instead."""
+        return await NetEndpoint.bind(addr)
+
     async def serve_with_shutdown(
         self, addr: "str | tuple", signal: Optional[Any]
     ) -> None:
         """Accept-loop until ``signal`` (an awaitable) resolves; ``None``
         serves forever (transport/server.rs:217-237)."""
-        ep = await NetEndpoint.bind(addr)
-        accept_task = mstask.spawn(self._accept_loop(ep), name=f"grpc-serve {addr}")
+        ep = await self._bind(addr)
+        local = getattr(ep, "local_addr", None)
+        self.bound_addr = local() if callable(local) else None
+        accept_task = self._spawn(self._accept_loop(ep), name=f"grpc-serve {addr}")
         try:
             if signal is None:
                 await accept_task
@@ -89,10 +108,10 @@ class Router:
             accept_task.abort()
             ep.close()
 
-    async def _accept_loop(self, ep: NetEndpoint) -> None:
+    async def _accept_loop(self, ep: Any) -> None:
         while True:
             tx, rx, _src = await ep.accept1()
-            mstask.spawn(self._serve_conn(tx, rx), name="grpc-conn")
+            self._spawn(self._serve_conn(tx, rx), name="grpc-conn")
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
         try:
@@ -120,7 +139,7 @@ class Router:
             tx.close()
             return
         # task per request (transport/server.rs:275-333)
-        mstask.spawn(
+        self._spawn(
             self._dispatch(kind, handler, request, tx, rx),
             name=f"grpc-handle {path}",
         )

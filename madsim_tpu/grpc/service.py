@@ -96,6 +96,8 @@ class ServiceClient:
     client-streaming/bidi take an iterable or async iterable of messages.
     """
 
+    _grpc_cls: "type | None" = None  # real/grpc.py overrides
+
     def __init__(self, service_cls: type, channel: Channel,
                  interceptor: Optional[Callable] = None):
         from .client import Grpc
@@ -103,7 +105,7 @@ class ServiceClient:
         self._cls = service_cls
         self._name = getattr(service_cls, _NAME_ATTR)
         self._table = getattr(service_cls, _TABLE_ATTR)
-        self._grpc = Grpc(channel, interceptor)
+        self._grpc = (type(self)._grpc_cls or Grpc)(channel, interceptor)
 
     @classmethod
     def with_interceptor(cls, service_cls: type, channel: Channel,

@@ -38,6 +38,11 @@ class Status(Exception):
         self.message = message
         super().__init__(f"status: {self.code.name}, message: {message!r}")
 
+    def __str__(self) -> str:
+        # derived from the fields, not Exception.args, so a Status decoded
+        # from the wire (real/codec.py skips __init__) still prints fully
+        return f"status: {Code(self.code).name}, message: {self.message!r}"
+
     # tonic-style constructors ------------------------------------------------
 
     @classmethod

@@ -49,7 +49,12 @@ _rebound_aliases: list = []
 # (attr, kind) sites per module — repeat installs only rescan modules
 # that appeared (or were reloaded) since, instead of every attribute of
 # every module (measured ~3 ms/scan; installs happen per block_on)
-_scanned_ids: dict = {}
+# name -> module (weak): compared by identity against the LIVE object, so a
+# re-imported module whose object happens to reuse a freed id is re-scanned
+# instead of silently skipped
+import weakref as _weakref
+
+_scanned_mods: "_weakref.WeakValueDictionary" = _weakref.WeakValueDictionary()
 _alias_sites: dict = {}
 
 
@@ -260,7 +265,7 @@ def _rebind_datetime_aliases(sim_datetime, sim_date) -> None:
     for name, mod in list(sys.modules.items()):
         if mod is None or name in ("datetime", __name__):
             continue
-        if _scanned_ids.get(name) == id(mod):
+        if _scanned_mods.get(name) is mod:
             continue
         sites = []
         try:
@@ -272,7 +277,10 @@ def _rebind_datetime_aliases(sim_datetime, sim_date) -> None:
                 sites.append((attr, "datetime"))
             elif val is real_date:
                 sites.append((attr, "date"))
-        _scanned_ids[name] = id(mod)
+        try:
+            _scanned_mods[name] = mod
+        except TypeError:
+            pass  # non-weakref-able module-like object: rescan next time
         if sites:
             _alias_sites[name] = sites
         else:

@@ -26,11 +26,17 @@ from .runtime import Runtime, spawn
 from .time import Instant, interval, now_instant, sleep, timeout
 from .net import Endpoint, TcpEndpoint
 from . import codec
+from . import stream
+from . import grpc
+from . import etcd
 
 __all__ = [
     "Endpoint",
     "TcpEndpoint",
     "codec",
+    "etcd",
+    "grpc",
+    "stream",
     "Instant",
     "Runtime",
     "interval",

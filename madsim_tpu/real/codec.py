@@ -21,6 +21,7 @@ u64 RPC ids and tags round-trip exactly.
 from __future__ import annotations
 
 import struct
+from enum import Enum
 from typing import Any, Dict
 
 from ..net import rpc as _rpc
@@ -35,8 +36,10 @@ _EXTRA_TYPES: Dict[str, type] = {}
 
 def register(cls: type) -> type:
     """Explicitly allow a non-Request class on the wire (decorator-friendly).
-    Its instances are encoded as their ``__dict__`` of plain data."""
-    if getattr(cls, "__dictoffset__", 0) == 0:
+    Instances are encoded as their ``__dict__`` of plain data; ``Enum``
+    subclasses are encoded by member name (decoded via ``cls[name]``, never
+    by constructing)."""
+    if not issubclass(cls, Enum) and getattr(cls, "__dictoffset__", 0) == 0:
         raise CodecError(
             f"cannot register {cls.__qualname__}: its instances have no "
             "__dict__ (__slots__ class?) — the codec round-trips objects "
@@ -60,7 +63,7 @@ def _lookup(name: str) -> type:
 # type tags
 _NONE, _TRUE, _FALSE = b"N", b"T", b"F"
 _INT, _FLOAT, _STR, _BYTES = b"i", b"f", b"s", b"b"
-_TUPLE, _LIST, _DICT, _OBJ = b"t", b"l", b"d", b"O"
+_TUPLE, _LIST, _DICT, _OBJ, _ENUM = b"t", b"l", b"d", b"O", b"E"
 
 _MAX_DEPTH = 32
 
@@ -74,6 +77,15 @@ def _enc(obj: Any, out: bytearray, depth: int) -> None:
         out += _TRUE
     elif obj is False:
         out += _FALSE
+    elif isinstance(obj, Enum):
+        # checked before int so IntEnum members (e.g. grpc Code) keep
+        # their type across the wire instead of flattening to int
+        cls = type(obj)
+        name = f"{cls.__module__}::{cls.__qualname__}"
+        _lookup(name)  # refuse to encode unregistered enums too
+        raw, member = name.encode(), obj.name.encode()
+        out += _ENUM + struct.pack(">I", len(raw)) + raw
+        out += struct.pack(">I", len(member)) + member
     elif isinstance(obj, int):
         raw = obj.to_bytes((obj.bit_length() + 8) // 8 or 1, "big", signed=True)
         out += _INT + struct.pack(">I", len(raw)) + raw
@@ -160,13 +172,28 @@ def _dec(r: _Reader, depth: int) -> Any:
             k = _dec(r, depth + 1)
             out[k] = _dec(r, depth + 1)
         return out
+    if tag == _ENUM:
+        name = r.take(r.u32()).decode()
+        cls = _lookup(name)
+        if not (isinstance(cls, type) and issubclass(cls, Enum)):
+            raise CodecError(f"{name!r} is not a registered Enum")
+        member = r.take(r.u32()).decode()
+        try:
+            return cls[member]
+        except KeyError:
+            raise CodecError(f"{name!r} has no member {member!r}") from None
     if tag == _OBJ:
         name = r.take(r.u32()).decode()
         cls = _lookup(name)
         fields = _dec(r, depth + 1)
         if not isinstance(fields, dict):
             raise CodecError("object fields must decode to a dict")
-        obj = object.__new__(cls)
+        if issubclass(cls, BaseException):
+            # object.__new__ refuses exception types; BaseException.__new__
+            # allocates without running any user __init__/__new__
+            obj = BaseException.__new__(cls)
+        else:
+            obj = object.__new__(cls)
         obj.__dict__.update(fields)
         return obj
     raise CodecError(f"unknown type tag {tag!r}")

@@ -1,0 +1,134 @@
+"""Real-mode gRPC twin: the same service classes over real TCP.
+
+The reference's madsim-tonic compiles to *real* tonic when ``--cfg madsim``
+is absent (madsim-tonic/src/lib.rs:1-8) — an app written against the shim
+runs against real HTTP/2 without code changes.  This module is that
+property for the Python framework: every piece of the sim gRPC stack
+(service decorators, typed clients, the four call shapes, interceptors,
+grpc-timeout, Status mapping, load-balanced channels) is reused verbatim;
+only the executor bindings (asyncio instead of the deterministic scheduler)
+and the transport (framed TCP streams, real/stream.py) are swapped::
+
+    from madsim_tpu import real
+    from madsim_tpu.real import grpc
+
+    # server
+    await grpc.Server.builder().add_service(Greeter()).serve("127.0.0.1:50051")
+    # client
+    channel = await grpc.Endpoint.from_static("http://127.0.0.1:50051").connect()
+    client = grpc.ServiceClient(Greeter, channel)
+
+Wire safety: frames use the restricted codec (real/codec.py), so only plain
+data and registered classes travel.  The envelope types (Request, Response,
+Status, Code) are registered here; user message classes must be registered
+with ``real.codec.register`` (the analogue of deriving Serialize in the
+reference — wire types are always declared explicitly).
+"""
+
+from __future__ import annotations
+
+import random as _pyrandom
+from typing import Any, Optional
+
+from ..grpc import codec as _gcodec
+from ..grpc.channel import Change, Channel as _SimChannel, Endpoint as _SimEndpoint
+from ..grpc.client import Grpc as _SimGrpc, Request, Response
+from ..grpc.codec import Streaming
+from ..grpc.server import Router as _SimRouter, ServerBuilder as _SimServerBuilder
+from ..grpc.service import (
+    ServiceClient as _SimServiceClient,
+    bidi_streaming,
+    client_streaming,
+    server_streaming,
+    service,
+    unary,
+)
+from ..grpc.status import Code, Status
+from . import codec, stream
+from . import time as rtime
+from .runtime import spawn
+
+# envelope types every call carries — registered once, like the serde
+# derives on the reference's envelope structs
+codec.register(Request)
+codec.register(Response)
+codec.register(Status)
+codec.register(Code)
+
+
+class Grpc(_SimGrpc):
+    """The generic caller bound to asyncio (spawn/timeout swapped)."""
+
+    _spawn = staticmethod(spawn)
+    _timeout = staticmethod(rtime.timeout)
+    _timeout_error = rtime.TimeoutError
+
+
+class Channel(_SimChannel):
+    """Load-balanced channel dialing real framed-TCP connections."""
+
+    @staticmethod
+    def _randint(n: int) -> int:
+        return _pyrandom.randrange(n)  # real mode: real randomness
+
+    async def _open(self, addr: str):
+        try:
+            return await stream.connect(addr)
+        except (ConnectionError, OSError) as e:
+            raise Status.unavailable(f"transport error: {e}") from None
+
+
+class Endpoint(_SimEndpoint):
+    """The tonic ``transport::Endpoint`` builder, real-mode flavor."""
+
+    _channel_cls = Channel
+    _timeout_fn = staticmethod(rtime.timeout)
+    _timeout_error = rtime.TimeoutError
+
+
+class ServiceClient(_SimServiceClient):
+    """Typed client for a @service class over the real transport."""
+
+    _grpc_cls = Grpc
+
+
+class Router(_SimRouter):
+    """The sim router/dispatcher serving on a real TCP listener."""
+
+    _spawn = staticmethod(spawn)
+
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        return await stream.StreamListener.bind(addr)
+
+
+class ServerBuilder(_SimServerBuilder):
+    _router_cls = Router
+
+
+class Server:
+    @staticmethod
+    def builder() -> ServerBuilder:
+        return ServerBuilder()
+
+
+__all__ = [
+    "Change",
+    "Channel",
+    "Code",
+    "Endpoint",
+    "Grpc",
+    "Request",
+    "Response",
+    "Router",
+    "Server",
+    "ServerBuilder",
+    "ServiceClient",
+    "Status",
+    "Streaming",
+    "bidi_streaming",
+    "client_streaming",
+    "server_streaming",
+    "service",
+    "unary",
+]

@@ -26,7 +26,6 @@ conventions so the same service classes work in both modes.
 from __future__ import annotations
 
 import asyncio
-import struct
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..net.rpc import request_id
@@ -34,17 +33,11 @@ from . import codec
 from . import time as rtime
 from .runtime import spawn
 
+# one source of truth for the wire rules, shared with the connection-
+# oriented transport (real/stream.py)
+from .stream import _LEN, _MAX_FRAME, encode_frame, parse_addr as _parse
+
 Addr = Tuple[str, int]
-
-_LEN = struct.Struct(">I")
-_MAX_FRAME = 64 * 1024 * 1024  # sanity bound, not a protocol limit
-
-
-def _parse(addr: "str | Addr") -> Addr:
-    if isinstance(addr, tuple):
-        return (addr[0], int(addr[1]))
-    host, _, port = addr.rpartition(":")
-    return (host or "127.0.0.1", int(port))
 
 
 class _Mailbox:
@@ -204,12 +197,7 @@ class _TcpConn:
         self.writer = writer
 
     async def write_frame(self, body: bytes) -> None:
-        if len(body) > _MAX_FRAME:
-            # fail at the sender; the receiver would kill the connection
-            raise ValueError(
-                f"frame of {len(body)} bytes exceeds the {_MAX_FRAME}-byte bound"
-            )
-        self.writer.write(_LEN.pack(len(body)) + body)
+        self.writer.write(encode_frame(body))
         await self.writer.drain()
 
     async def read_frame(self) -> bytes:
