@@ -84,16 +84,33 @@ class Future:
 
     def subscribe(self, task: "Task") -> None:
         """Called by the executor when a task blocks on this pollable."""
-        if self.done():
+        # inlined done() — this runs once per executor poll
+        if self._value is not _PENDING or self._exc is not None:
             task.wake()
             return
         if task not in self._wakers:
             self._wakers.append(task)
 
     def __await__(self) -> Generator[Any, None, Any]:
-        while not self.done():
+        while self._value is _PENDING and self._exc is None:  # inlined done()
             yield self
         return self.result()
+
+
+_PyFuture = Future
+
+# Swap in the compiled Future (native/simloop.c) when available: same
+# contract (state machine, FIFO wakers, __await__ yields self until
+# resolved), with set_result/subscribe/__await__ running in C.  The
+# schedule is unchanged — wakers fire in the same order either way.
+try:
+    from . import native as _native
+
+    _simloop = _native.simloop()
+except Exception:  # pragma: no cover - native tier is always optional
+    _simloop = None
+if _simloop is not None:
+    Future = _simloop.Future  # type: ignore[misc]
 
 
 class JoinHandle(Future):

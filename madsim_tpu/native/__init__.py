@@ -167,3 +167,64 @@ def random_bits(k0: int, k1: int, n: int) -> list:
         o0, o1 = threefry2x32(k0, k1, (i >> 32) & 0xFFFFFFFF, i & 0xFFFFFFFF)
         out.append(o0 ^ o1)
     return out
+
+
+# ---------------------------------------------------------------- simloop
+# The compiled executor core (CPython extension, simloop.c): Future/Sleep/
+# Timers/Loop. Unlike the ctypes structures above (whose per-call overhead
+# caps their value), this runs the whole per-poll hot sequence in C.
+
+_SIMLOOP_SRC = os.path.join(_DIR, "simloop.c")
+_SIMLOOP_SO = os.path.join(_DIR, "_simloop.so")
+
+_simloop_mod = None
+_simloop_failed = False
+
+
+def _build_simloop() -> bool:
+    import sysconfig
+
+    try:
+        subprocess.run(
+            [
+                # plain C: tentative type definitions + the CPython C API
+                "gcc", "-O2", "-shared", "-fPIC", "-std=c11",
+                "-I" + sysconfig.get_paths()["include"],
+                _SIMLOOP_SRC, "-o", _SIMLOOP_SO,
+            ],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
+        return True
+    except Exception:
+        return False
+
+
+def simloop():
+    """The `_simloop` extension module, or None (build failure or
+    MADSIM_NO_NATIVE=1). Built lazily like the ctypes core."""
+    global _simloop_mod, _simloop_failed
+    if _simloop_mod is not None:
+        return _simloop_mod
+    if _simloop_failed or os.environ.get("MADSIM_NO_NATIVE"):
+        return None
+    if not os.path.exists(_SIMLOOP_SO) or (
+        os.path.getmtime(_SIMLOOP_SO) < os.path.getmtime(_SIMLOOP_SRC)
+    ):
+        if not _build_simloop():
+            _simloop_failed = True
+            return None
+    try:
+        import importlib.util
+
+        spec = importlib.util.spec_from_file_location(
+            "madsim_tpu.native._simloop", _SIMLOOP_SO
+        )
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+    except Exception:
+        _simloop_failed = True
+        return None
+    _simloop_mod = mod
+    return mod

@@ -23,7 +23,7 @@ from ..futures import Future
 from ..plugin import Simulator
 from ..rand import GlobalRng
 from ..task import NodeId
-from ..time import Sleep, TimeHandle
+from ..time import Sleep, TimeHandle, _new_sleep
 from .dns import DnsServer
 from .ipvs import IpVirtualServer, ServiceAddr
 from .network import TCP, UDP, Addr, Network, Socket, Stat, parse_addr
@@ -119,7 +119,7 @@ class NetSim(Simulator):
 
     def _sleep_ns(self, ns: int) -> Sleep:
         """Raw virtual sleep without the 1 ms tokio minimum."""
-        return Sleep(self.time, self.time.now_ns + max(0, int(ns)))
+        return _new_sleep(self.time, self.time.now_ns + max(0, int(ns)))
 
     async def rand_delay(self) -> None:
         """0-5 µs processing delay; buggified to 1-5 s at 10%

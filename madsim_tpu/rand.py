@@ -24,7 +24,7 @@ from typing import Any, List, MutableSequence, Optional, Sequence, TypeVar
 
 import numpy as np
 
-from .context import current_handle
+from .context import _tls as _ctx_tls, current_handle
 
 T = TypeVar("T")
 
@@ -111,14 +111,19 @@ class GlobalRng:
     def next_u64(self) -> int:
         pos = self._buf_pos
         buf = self._buf
-        if buf is None or pos >= len(buf):
+        if buf is None or pos >= 1024:
+            # .tolist() once per refill: indexing a Python list yields ints
+            # directly, vs a numpy scalar + int() conversion per draw
             buf = self._buf = self._gen.integers(
                 0, 1 << 64, size=1024, dtype=np.uint64
-            )
+            ).tolist()
             pos = 0
         self._buf_pos = pos + 1
-        v = int(buf[pos])
-        self._record(v)
+        v = buf[pos]
+        if self._log is None and self._check is None:
+            self._draw_count += 1  # inlined _record fast path
+        else:
+            self._record(v)
         return v
 
     def next_u32(self) -> int:
@@ -176,7 +181,11 @@ class GlobalRng:
 
 def rng() -> GlobalRng:
     """The current simulation's RNG (reference ``thread_rng``)."""
-    return current_handle().rng
+    # hand-inlined ambient lookup (hot: every module-level draw)
+    h = getattr(_ctx_tls, "handle", None)
+    if h is None:
+        return current_handle().rng  # raises NoContextError
+    return h.rng
 
 
 def random() -> float:

@@ -32,7 +32,7 @@ from .metrics import RuntimeMetrics
 from .plugin import Simulator
 from .rand import GlobalRng
 from .task import Executor, NodeId, NodeInfo, MAIN_NODE_ID
-from .time import TimeHandle
+from .time import TimeHandle, make_time_handle
 
 S = TypeVar("S", bound=Simulator)
 
@@ -222,7 +222,7 @@ class Runtime:
 
             seed = _walltime.time_ns()  # ref builder.rs:64-73 default seed
         self.rng = GlobalRng(seed)
-        self.time = TimeHandle(self.rng)
+        self.time = make_time_handle(self.rng)
         self.config = config or Config()
         self.executor = Executor(self.rng, self.time)
         self.handle = Handle(self.rng, self.time, self.executor, self.config)
@@ -258,10 +258,22 @@ class Runtime:
         coro = main() if callable(main) and not inspect.iscoroutine(main) else main
         assert inspect.iscoroutine(coro), "block_on expects a coroutine"
         allow_thread = getattr(self, "_allow_system_thread", False)
-        with context.enter_handle(self.handle), interposed(
-            self.handle, allow_system_thread=allow_thread
-        ):
-            return self.executor.block_on(coro)
+        # Relax the gen-0 cycle-GC threshold for the duration of the sim:
+        # the executor allocates mostly-acyclic objects at event rate, and
+        # collection timing cannot affect schedules (no draws, no sim
+        # state), only wall-clock. Restored on exit.
+        import gc
+
+        thresholds = gc.get_threshold()
+        if thresholds[0] > 0:  # 0 = embedder disabled GC; leave it off
+            gc.set_threshold(max(thresholds[0], 50_000), *thresholds[1:])
+        try:
+            with context.enter_handle(self.handle), interposed(
+                self.handle, allow_system_thread=allow_thread
+            ):
+                return self.executor.block_on(coro)
+        finally:
+            gc.set_threshold(*thresholds)
 
     @staticmethod
     def check_determinism(

@@ -58,6 +58,7 @@ class Task:
         "cancelled",
         "finished",
         "_executor",
+        "_ready_items",  # direct list ref for the default queue (fast wake)
     )
 
     def __init__(
@@ -78,13 +79,19 @@ class Task:
         self.cancelled = False
         self.finished = False
         self._executor = executor
+        ready = executor.ready
+        self._ready_items = ready._items if type(ready) is _PyReadyQueue else None
 
     def wake(self) -> None:
         """Enqueue this task for polling (idempotent while scheduled)."""
         if self.finished or self.scheduled:
             return
         self.scheduled = True
-        self._executor.ready.append(self)
+        items = self._ready_items
+        if items is not None:
+            items.append(self)  # default queue: skip two method dispatches
+        else:
+            self._executor.ready.append(self)
 
     def abort(self) -> None:
         """tokio ``AbortHandle::abort`` — mark cancelled and wake so the
@@ -202,6 +209,18 @@ class Executor:
         self.rng = rng
         self.time = time
         self.ready = _make_ready_queue()
+        # compiled ready-loop driver (native/simloop.c) — available when
+        # the time core is compiled and the default Python queue is in use
+        self._cloop = None
+        core = getattr(time, "_core", None)
+        if core is not None and type(self.ready) is _PyReadyQueue:
+            from . import native as _native
+
+            sl = _native.simloop()
+            if sl is not None:
+                self._cloop = sl.Loop(
+                    self, self.ready._items, rng, core, context._tls
+                )
         self.nodes: Dict[NodeId, NodeInfo] = {}
         self._next_node_id = 1
         self._next_task_id = 1
@@ -249,6 +268,20 @@ class Executor:
         """Run ``coro`` as the main task until completion
         (ref ``Executor::block_on``, task/mod.rs:220-260)."""
         main = self.spawn_on(self.main_node, coro, name="main", spawn_site="main")
+        if self._cloop is not None:
+            # the whole inner loop is compiled (ref task/mod.rs:220-260)
+            limit = self.time_limit_ns
+            return self._cloop.run(
+                main,
+                DeadlockError,
+                TimeLimitError,
+                -1 if limit is None else limit,
+                50,  # _JUMP_EPSILON_NS
+                None if limit is None else (
+                    f"simulated time limit exceeded "
+                    f"({limit / 1e9:.3f}s of virtual time)"
+                ),
+            )
         while True:
             self.run_all_ready()
             if main.done():
@@ -269,13 +302,27 @@ class Executor:
 
     def run_all_ready(self) -> None:
         """Drain the ready queue in random order
-        (ref ``run_all_ready``, task/mod.rs:263-316)."""
+        (ref ``run_all_ready``, task/mod.rs:263-316).
+
+        The Python-queue fast path inlines swap_remove and the 50-100 ns
+        jitter advance; pop indices and jitter still come from the same
+        GlobalRng draws in the same order, so schedules are byte-identical
+        with the method-dispatch path (and with MADSIM_NATIVE)."""
         ready = self.ready
-        rng = self.rng
-        while len(ready):
-            # random swap-remove pop (ref sim/utils/mpsc.rs:73-83)
-            idx = rng.gen_range(0, len(ready))
-            task = ready.swap_remove(idx)
+        rng_next = self.rng.next_u64
+        time = self.time
+        items = ready._items if type(ready) is _PyReadyQueue else None
+        if items is None:
+            self._run_all_ready_generic()
+            return
+        while items:
+            n = len(items)
+            # random swap-remove pop (ref sim/utils/mpsc.rs:73-83);
+            # inlined gen_range(0, n) — Lemire reduction
+            idx = rng_next() * n >> 64
+            task = items[idx]
+            items[idx] = items[-1]
+            items.pop()
             task.scheduled = False
             if task.finished:
                 continue
@@ -288,30 +335,74 @@ class Executor:
                 node.paused_tasks.append(task)
                 continue
             self._poll(task)
-            # random 50-100 ns advance per poll (ref task/mod.rs:312-315)
+            # random 50-100 ns advance per poll (ref task/mod.rs:312-315);
+            # inlined gen_range(50, 101)
+            time.advance_ns(50 + (rng_next() * 51 >> 64))
+
+    def _run_all_ready_generic(self) -> None:
+        """Method-dispatch drain for non-default queue backends
+        (MADSIM_NATIVE) — same draws, same order as the fast path."""
+        ready = self.ready
+        rng = self.rng
+        while len(ready):
+            idx = rng.gen_range(0, len(ready))
+            task = ready.swap_remove(idx)
+            task.scheduled = False
+            if task.finished:
+                continue
+            node = task.node
+            if task.cancelled or node.killed:
+                self._drop_task(task)
+                continue
+            if node.paused:
+                node.paused_tasks.append(task)
+                continue
+            self._poll(task)
             self.time.advance_ns(rng.gen_range(50, 101))
 
     def _poll(self, task: Task) -> None:
-        with context.enter_task(task):
-            try:
-                pollable = task.coro.send(None)
-            except StopIteration as stop:
-                self._finish(task)
-                task.join.set_result(stop.value)
-                return
-            except _TaskExit:
-                self._finish(task)
-                task.join.set_result(None)
-                return
-            except Exception as exc:  # noqa: BLE001 — the catch_unwind analogue
-                self._finish(task)
-                self._on_panic(task, exc)
-                return
-            pollable.subscribe(task)
+        prev = context.swap_task(task)
+        try:
+            pollable = task.coro.send(None)
+        except StopIteration as stop:
+            self._finish(task)
+            task.join.set_result(stop.value)
+            return
+        except _TaskExit:
+            self._finish(task)
+            task.join.set_result(None)
+            return
+        except Exception as exc:  # noqa: BLE001 — the catch_unwind analogue
+            self._finish(task)
+            self._on_panic(task, exc)
+            return
+        finally:
+            context.swap_task(prev)
+        pollable.subscribe(task)
 
     def _finish(self, task: Task) -> None:
         task.finished = True
         task.node.tasks.pop(task.id, None)
+
+    # -- callbacks for the compiled loop (native/simloop.c) ---------------
+
+    def _complete(self, task: Task, value: Any) -> None:
+        """Task coroutine returned ``value`` (the StopIteration branch)."""
+        self._finish(task)
+        task.join.set_result(value)
+
+    def _poll_raised(self, task: Task, exc: BaseException) -> bool:
+        """Exception out of a poll; returns False to propagate (the
+        KeyboardInterrupt/SystemExit path, mirroring ``except Exception``)."""
+        if isinstance(exc, _TaskExit):
+            self._finish(task)
+            task.join.set_result(None)
+            return True
+        if isinstance(exc, Exception):
+            self._finish(task)
+            self._on_panic(task, exc)
+            return True
+        return False
 
     def _drop_task(self, task: Task) -> None:
         """Drop a cancelled/killed task's coroutine, running its ``finally``

@@ -21,7 +21,7 @@ import heapq
 from enum import Enum
 from typing import Any, Callable, Generator, List, Optional, Tuple
 
-from .context import current_handle
+from .context import _tls as _ctx_tls, current_handle
 from .futures import Future
 from .rand import GlobalRng
 
@@ -66,6 +66,13 @@ class Instant:
 
     def __le__(self, other: "Instant") -> bool:
         return self.ns <= other.ns
+
+    # explicit so `a >= b` doesn't pay Python's reflected-dispatch fallback
+    def __gt__(self, other: "Instant") -> bool:
+        return self.ns > other.ns
+
+    def __ge__(self, other: "Instant") -> bool:
+        return self.ns >= other.ns
 
     def __hash__(self) -> int:
         return hash(("Instant", self.ns))
@@ -228,7 +235,13 @@ class TimeHandle:
     def advance_ns(self, delta_ns: int) -> None:
         """Jump the clock forward, firing any timers that become due
         (``time::advance`` / per-poll 50-100ns advance)."""
-        self._clock_ns += delta_ns
+        clock = self._clock_ns = self._clock_ns + delta_ns
+        # fast path: nothing due (runs once per executor poll) — a
+        # cancelled head entry compares the same, so skipping is correct
+        heap = getattr(self._q, "_heap", None)
+        if type(heap) is list:
+            if not heap or heap[0][0] > clock:
+                return
         self._fire_due()
 
     def advance(self, seconds: float) -> None:
@@ -244,6 +257,79 @@ class TimeHandle:
         self._clock_ns = max(self._clock_ns, deadline + _JUMP_EPSILON_NS)
         self._fire_due()
         return True
+
+
+# -- compiled time core (native/simloop.c) ---------------------------------
+
+try:
+    from . import native as _native
+
+    _simloop = _native.simloop()
+except Exception:  # pragma: no cover - native tier is always optional
+    _simloop = None
+if _simloop is not None:
+    _simloop._configure(Instant)  # lets the C Sleep build .deadline Instants
+
+
+class _NativeTimeHandle(TimeHandle):
+    """TimeHandle over the compiled clock + timer heap (native/simloop.c).
+
+    Identical (deadline, insertion-seq) ordering and jump semantics as the
+    Python heapq path — schedules are byte-identical with the core on or
+    off (MADSIM_NO_NATIVE=1)."""
+
+    def __init__(self, rng: GlobalRng):
+        # same epoch draw as the base class, so the RNG stream is identical
+        self._epoch_ns = (
+            _EPOCH_2022_S * NANOS_PER_SEC
+            + rng.gen_range(0, 365 * 24 * 3600) * NANOS_PER_SEC
+        )
+        self._core = core = _simloop.Timers()
+        self._q = None  # the heap lives in the core
+        rng._now_ns = lambda: core.clock
+
+    @property
+    def now_ns(self) -> int:
+        return self._core.clock
+
+    def now_instant(self) -> Instant:
+        return Instant(self._core.clock)
+
+    def now_time_ns(self) -> int:
+        return self._epoch_ns + self._core.clock
+
+    def elapsed(self) -> float:
+        return self._core.clock / NANOS_PER_SEC
+
+    def add_timer_at_ns(self, deadline_ns: int, callback: Callable[[], None]):
+        return self._core.push(deadline_ns, callback)
+
+    def add_timer_ns(self, delay_ns: int, callback: Callable[[], None]):
+        core = self._core
+        return core.push(core.clock + max(0, delay_ns), callback)
+
+    def next_deadline_ns(self) -> Optional[int]:
+        return self._core.peek_deadline()
+
+    def _fire_due(self) -> int:
+        return self._core.fire_due()
+
+    def advance_ns(self, delta_ns: int) -> None:
+        self._core.advance_ns(delta_ns)
+
+    def advance_to_next_event(self) -> bool:
+        return self._core.advance_to_next_event(_JUMP_EPSILON_NS)
+
+
+def make_time_handle(rng: GlobalRng) -> TimeHandle:
+    """The runtime's TimeHandle factory: compiled core by default,
+    pure Python under MADSIM_NO_NATIVE=1 (or MADSIM_NATIVE=1, which
+    selects the older ctypes heap instead)."""
+    import os
+
+    if _simloop is not None and not os.environ.get("MADSIM_NATIVE"):
+        return _NativeTimeHandle(rng)
+    return TimeHandle(rng)
 
 
 # -- Sleep future (sim/time/sleep.rs:20-55) --------------------------------
@@ -299,15 +385,44 @@ class Sleep(Future):
                 )
 
 
+def _new_sleep(t: TimeHandle, deadline_ns: int):
+    """Sleep factory: the C Sleep on the compiled core, else the Python
+    one — same lazy first-subscribe timer arming either way."""
+    core = getattr(t, "_core", None)
+    if core is not None:
+        return _simloop.Sleep(core, deadline_ns)
+    return Sleep(t, deadline_ns)
+
+
+_ns_cache: dict = {}  # duration float -> clamped ns (workloads reuse a few constants)
+
+
 def sleep(seconds: float) -> Sleep:
     """Sleep for a virtual duration (min 1 ms, tokio parity)."""
-    t = current_handle().time
-    return Sleep(t, t.now_ns + max(_to_ns(seconds), MIN_SLEEP_NS))
+    # hand-inlined ambient lookup + _to_ns: this is the hottest API call
+    # in a typical workload (one per task loop iteration)
+    h = getattr(_ctx_tls, "handle", None)
+    if h is None:
+        current_handle()  # raises NoContextError with the standard message
+    ns = _ns_cache.get(seconds)
+    if ns is None:
+        if seconds < 0:
+            raise ValueError("duration must be non-negative")
+        ns = int(round(seconds * NANOS_PER_SEC))
+        if ns < MIN_SLEEP_NS:
+            ns = MIN_SLEEP_NS
+        if len(_ns_cache) < 4096:
+            _ns_cache[seconds] = ns
+    t = h.time
+    core = getattr(t, "_core", None)
+    if core is not None:
+        return _simloop.Sleep(core, core.clock + ns)
+    return Sleep(t, t.now_ns + ns)
 
 
 def sleep_until(deadline: Instant) -> Sleep:
     t = current_handle().time
-    return Sleep(t, deadline.ns)
+    return _new_sleep(t, deadline.ns)
 
 
 class _InlineTimeout:
@@ -408,7 +523,7 @@ class Interval:
         return self._period_ns / NANOS_PER_SEC
 
     async def tick(self) -> Instant:
-        await Sleep(self._time, self._deadline_ns)
+        await _new_sleep(self._time, self._deadline_ns)
         scheduled = self._deadline_ns
         now = self._time.now_ns
         b = self.missed_tick_behavior
@@ -440,7 +555,12 @@ def interval_at(start: Instant, period: float) -> Interval:
 
 
 def now_instant() -> Instant:
-    return current_handle().time.now_instant()
+    h = getattr(_ctx_tls, "handle", None)
+    if h is None:
+        current_handle()  # raises NoContextError
+    t = h.time
+    core = getattr(t, "_core", None)
+    return Instant(core.clock if core is not None else t._clock_ns)
 
 
 def now() -> float:
