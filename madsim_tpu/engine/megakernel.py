@@ -1,0 +1,489 @@
+"""VMEM-resident multi-step megakernel — the round-3 headroom probe.
+
+``docs/pallas_finding.md`` §3 measured the flat-loop sweep at ~300 GB/s of
+~820 GB/s HBM and attributed the gap to the loop carry round-tripping HBM
+every event; the named fix was a *full-step megakernel* that keeps a
+seed-tile's whole state resident in VMEM across many steps. This module
+builds that kernel and measures it honestly.
+
+Scope: the kernel implements the engine's COMPLETE per-event step — counter
+RNG (threefry, bit-identical to ``jax.random``), ``pop_min`` with the
+murmur tie-break, 64-bit virtual-time arithmetic (int64 emulated as
+sign-biased (hi, lo) int32 planes — TPU vector units have no int64 lanes),
+the done/time-limit masking of ``core.step_one``, the handler, and the
+rank-select push — for a *probe workload* (``probe_workload``) with the
+same structural shape as the MadRaft model: Q=58 queue, 8 payload slots,
+15 draws/event, 7-wide emit batch, a [5, 32] log-like state plane. The
+workload is defined once as ordinary engine code, so the XLA path runs it
+via ``run_sweep``'s machinery and the kernel's final state must match
+**bit-exactly** (asserted in tests and in the bench).
+
+Why a probe workload and not the raft model itself: the megakernel
+hypothesis is about *memory residency*, not about raft — a structurally
+faithful step (same queue, same RNG cost, same masked-write pattern, same
+state footprint) measures the residency effect at ~1/4 of the kernel
+surface. If the probe shows a win, porting the raft handler is mechanical
+follow-up; if it shows none, the headroom claim is closed for every
+workload of this shape.
+
+Reference analogy: the ref's hot loop is compiled and cache-resident by
+construction (madsim/src/sim/task/mod.rs:220-317); this is the TPU-tier
+equivalent question — can the event loop live in fast memory?
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import queue as equeue
+from .core import Emits, EngineConfig, EngineState, Workload
+from .queue import INVALID_TIME, _HASH_MULT
+from .rng import bounded
+
+# -- probe workload (runs on BOTH paths) -----------------------------------
+
+_N = 5  # nodes (raft parity)
+_L = 32  # log slots per node
+_Q = 58  # queue capacity (raft config #3)
+_P = 8  # payload slots
+_NUM_RAND = 13  # raft: 2N+3
+_MAX_EMITS = 7  # raft: N+2
+_DELAY_LO = 1_000_000  # 1 ms
+_DELAY_HI = 20_000_001  # 20 ms
+
+
+class _ProbeW(NamedTuple):
+    ring: jnp.ndarray  # int32[N, L] — the raft log-write analogue
+    acc: jnp.ndarray  # int32 rolling mix of draws
+    nsent: jnp.ndarray  # int32 events handled
+
+
+def _probe_init(key) -> Tuple[_ProbeW, Emits]:
+    del key  # deterministic init: the A/B needs no extra draw stream
+    w = _ProbeW(
+        ring=jnp.zeros((_N, _L), jnp.int32),
+        acc=jnp.zeros((), jnp.int32),
+        nsent=jnp.zeros((), jnp.int32),
+    )
+    e = jnp.arange(_MAX_EMITS, dtype=jnp.int64)
+    times = (e + 1) * 1_000_000
+    kinds = jnp.zeros((_MAX_EMITS,), jnp.int32)
+    pays = jnp.zeros((_MAX_EMITS, _P), jnp.int32)
+    pays = pays.at[:, 0].set(jnp.arange(_MAX_EMITS, dtype=jnp.int32) % _N)
+    enables = e < _N  # N live timers, one per node
+    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+
+
+def _probe_handle(w: _ProbeW, now, kind, pay, rand) -> Tuple[_ProbeW, Emits]:
+    """One event: mix draws into state, one masked log write, re-arm one
+    timer on a random node — every arithmetic op integer, so the kernel
+    can reproduce it bit-for-bit."""
+    del kind
+    node = pay[0]
+    acc = (w.acc + (rand[0] ^ rand[1]).astype(jnp.int32)).astype(jnp.int32)
+    idx = jnp.bitwise_and(acc, _L - 1)
+    flat = jnp.arange(_N * _L, dtype=jnp.int32).reshape(_N, _L)
+    mask = flat == (node * _L + idx)
+    ring = jnp.where(mask, rand[2].astype(jnp.int32), w.ring)
+    nsent = w.nsent + 1
+
+    delay = bounded(rand[3], _DELAY_LO, _DELAY_HI)
+    next_node = bounded(rand[4], 0, _N).astype(jnp.int32)
+
+    times = jnp.full((_MAX_EMITS,), now, jnp.int64).at[0].set(now + delay)
+    kinds = jnp.zeros((_MAX_EMITS,), jnp.int32)
+    pays = jnp.zeros((_MAX_EMITS, _P), jnp.int32)
+    pays = pays.at[0, 0].set(next_node)
+    pays = pays.at[0, 1].set(rand[5].astype(jnp.int32))
+    enables = jnp.arange(_MAX_EMITS) < 1  # exactly the re-arm event
+    return _ProbeW(ring=ring, acc=acc, nsent=nsent), Emits(
+        times=times, kinds=kinds, pays=pays, enables=enables
+    )
+
+
+def probe_workload() -> Workload:
+    return Workload(
+        init=_probe_init,
+        handle=_probe_handle,
+        num_rand=_NUM_RAND,
+        payload_slots=_P,
+        max_emits=_MAX_EMITS,
+    )
+
+
+def probe_config(max_steps: int) -> EngineConfig:
+    # horizon far beyond max_steps * 20 ms so no seed ever finishes: both
+    # paths run exactly max_steps real events per seed
+    return EngineConfig(
+        queue_capacity=_Q,
+        time_limit_ns=1 << 62,
+        max_steps=max_steps,
+    )
+
+
+# -- 64-bit (hi, lo) int32-plane helpers (kernel side) ---------------------
+
+_SIGN = 0x80000000
+_INV_HI = int(INVALID_TIME) >> 32  # 0x7fffffff
+_INV_LO_B = 0x7FFFFFFF  # sign-biased lo half of INVALID_TIME
+
+
+def _u(x):
+    return x.astype(jnp.uint32)
+
+
+def _i(x):
+    return x.astype(jnp.int32)
+
+
+def _split64(t: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """int64 -> (hi int32, lo sign-biased int32): lexicographic signed
+    compare on the planes == int64 compare."""
+    hi = (t >> 32).astype(jnp.int32)
+    lo = ((t & 0xFFFFFFFF).astype(jnp.uint32) ^ jnp.uint32(_SIGN)).astype(jnp.int32)
+    return hi, lo
+
+
+def _join64(hi: jnp.ndarray, lob: jnp.ndarray) -> jnp.ndarray:
+    lo_u = (_u(lob) ^ jnp.uint32(_SIGN)).astype(jnp.int64)
+    return (hi.astype(jnp.int64) << 32) | lo_u
+
+
+def _add64_u32(hi, lob, delta_u32):
+    """(hi, lob) + delta (a uint32 < 2^31); returns (hi', lob')."""
+    lo_u = _u(lob) ^ jnp.uint32(_SIGN)
+    s = lo_u + _u(delta_u32)
+    carry = (s < lo_u).astype(jnp.int32)
+    return hi + carry, _i(s ^ jnp.uint32(_SIGN))
+
+
+def _gt64(ahi, alob, bhi, blob):
+    return (ahi > bhi) | ((ahi == bhi) & (alob > blob))
+
+
+def _max64(ahi, alob, bhi, blob):
+    agt = _gt64(ahi, alob, bhi, blob)
+    return jnp.where(agt, ahi, bhi), jnp.where(agt, alob, blob)
+
+
+def _mulhi32(x_u32, c: int):
+    """floor(x * c / 2**32) for a static c < 2**32, via 16-bit limbs —
+    the ``bounded`` reduction without int64 lanes."""
+    ch, cl = (c >> 16) & 0xFFFF, c & 0xFFFF
+    xh = _u(x_u32) >> 16
+    xl = _u(x_u32) & jnp.uint32(0xFFFF)
+    low = xl * cl
+    mid1 = xh * cl
+    mid2 = xl * ch
+    s = mid1 + mid2
+    c1 = (s < mid1).astype(jnp.uint32)
+    s2 = s + (low >> 16)
+    c2 = (s2 < s).astype(jnp.uint32)
+    return xh * ch + (s2 >> 16) + ((c1 + c2) << 16)
+
+
+# -- threefry2x32 (bit-identical to jax.random's stream) -------------------
+
+_ROT = (13, 15, 26, 6, 17, 29, 16, 24)
+
+
+def _rotl(x, d: int):
+    return (x << d) | (x >> (32 - d))
+
+
+def _threefry2x32(k0, k1, c0, c1):
+    """One threefry-2x32 block (20 rounds) on uint32 vectors — the same
+    math as native/simcore.cpp:threefry2x32 and jax.random."""
+    ks2 = k0 ^ k1 ^ jnp.uint32(0x1BD11BDA)
+    ks = (k0, k1, ks2)
+    x0 = c0 + ks[0]
+    x1 = c1 + ks[1]
+    for block in range(5):
+        r = _ROT[4:] if block % 2 else _ROT[:4]
+        for i in range(4):
+            x0 = x0 + x1
+            x1 = _rotl(x1, r[i])
+            x1 = x1 ^ x0
+        s = block + 1
+        x0 = x0 + ks[s % 3]
+        x1 = x1 + ks[(s + 1) % 3] + jnp.uint32(s)
+    return x0, x1
+
+
+def _event_words(k0, k1, ctr_u32, n: int):
+    """``event_bits(key, ctr, n)`` in-kernel: fold_in then n counter
+    draws, each word the XOR of the output pair.  Shapes: k0/k1/ctr are
+    [T, 1] uint32; returns [T, n] uint32."""
+    f0, f1 = _threefry2x32(k0, k1, jnp.zeros_like(ctr_u32), ctr_u32)
+    zeros = jnp.zeros((k0.shape[0], n), jnp.uint32)
+    idx = jax.lax.broadcasted_iota(jnp.uint32, (k0.shape[0], n), 1)
+    o0, o1 = _threefry2x32(f0, f1, zeros, idx)  # broadcasts [T,1] keys
+    return o0 ^ o1
+
+
+def _murmur_prio(iota_u32, tie_u32):
+    x = iota_u32 * jnp.uint32(_HASH_MULT) ^ tie_u32
+    x ^= x >> 16
+    x *= jnp.uint32(0x85EBCA6B)
+    x ^= x >> 13
+    x *= jnp.uint32(0xC2B2AE35)
+    return x ^ (x >> 16)
+
+
+# -- the megakernel --------------------------------------------------------
+
+
+def _mega_kernel(steps: int, time_limit: int, qp: int,
+                 # inputs (aliased to outputs)
+                 qthi_r, qtlo_r, qkind_r, qpay_r,
+                 key_r, now_r, ctr_r, done_r, ov_r, qmax_r,
+                 ring_r, acc_r, nsent_r,
+                 # outputs
+                 qthi_o, qtlo_o, qkind_o, qpay_o,
+                 key_o, now_o, ctr_o, done_o, ov_o, qmax_o,
+                 ring_o, acc_o, nsent_o):
+    """``steps`` engine events for one [T]-seed tile, all state in VMEM."""
+    lim_hi = time_limit >> 32
+    lim_lob = (time_limit & 0xFFFFFFFF) ^ _SIGN
+    if lim_lob >= 1 << 31:  # spell the biased lo half in int32 range
+        lim_lob -= 1 << 32
+
+    qthi = qthi_r[:]
+    qtlo = qtlo_r[:]
+    qkind = qkind_r[:]
+    qpay = qpay_r[:]  # int32[T, P, qp] — payload slot-major
+    k0 = _u(key_r[:, 0:1])
+    k1 = _u(key_r[:, 1:2])
+    now_hi = now_r[:, 0:1]
+    now_lob = now_r[:, 1:2]
+    ctr = ctr_r[:]
+    done = done_r[:]
+    ov = ov_r[:]
+    qmax = qmax_r[:]
+    ring = ring_r[:]
+    acc = acc_r[:]
+    nsent = nsent_r[:]
+
+    T = qthi.shape[0]
+    q_iota_u = jax.lax.broadcasted_iota(jnp.uint32, (T, qp), 1)
+    q_iota_i = jax.lax.broadcasted_iota(jnp.int32, (T, qp), 1)
+    ring_iota = jax.lax.broadcasted_iota(jnp.int32, (T, _N * _L), 1)
+
+    def body(_, carry):
+        (qthi, qtlo, qkind, qpay, now_hi, now_lob, ctr, done, ov, qmax,
+         ring, acc, nsent) = carry
+        active = done == 0
+
+        # draws (rand[0] jitter, rand[1] tie, rand[2:] handler)
+        w = _event_words(k0, k1, _u(ctr), _NUM_RAND + 2)
+
+        # ---- pop_min (lexicographic min + murmur tie-break) ----
+        mh = jnp.min(qthi, axis=1, keepdims=True)
+        c1m = qthi == mh
+        ml = jnp.min(jnp.where(c1m, qtlo, jnp.int32(0x7FFFFFFF)), axis=1,
+                     keepdims=True)
+        cand = c1m & (qtlo == ml)
+        prio = _murmur_prio(q_iota_u, w[:, 1:2])
+        pb = _i(prio ^ jnp.uint32(_SIGN))
+        mp = jnp.min(jnp.where(cand, pb, jnp.int32(0x7FFFFFFF)), axis=1,
+                     keepdims=True)
+        winner = cand & (pb == mp)
+        first = jnp.min(jnp.where(winner, q_iota_i, jnp.int32(qp)), axis=1,
+                        keepdims=True)
+        sel = q_iota_i == first  # one-hot popped slot [T, qp]
+        found = ~((mh == _INV_HI) & (ml == _INV_LO_B))  # [T,1]
+
+        # one-hot extraction via MAX, not sum: under x64 jnp.sum(int32)
+        # inserts an int64 convert that Mosaic cannot lower (and its
+        # _convert_helper recurses on). sel is always exactly one slot, so
+        # max-over-masked == the selected value. Downstream uses are
+        # take-gated exactly like the XLA path, so the !found garbage
+        # values never reach state.
+        imin = jnp.int32(-0x80000000)
+        kind = jnp.max(jnp.where(sel, qkind, imin), axis=1, keepdims=True)
+        pay = jnp.max(jnp.where(sel[:, None, :], qpay, imin), axis=2)  # [T,P]
+
+        # ---- clock: now' = max(now, t) + jitter ----
+        jitter = jnp.uint32(50) + _mulhi32(w[:, 0:1], 51)
+        nh, nl = _max64(now_hi, now_lob, mh, ml)
+        nh, nl = _add64_u32(nh, nl, jitter)
+        time_up = _gt64(nh, nl, jnp.int32(lim_hi), jnp.int32(lim_lob))
+        dispatch = found & ~time_up
+        take = active & dispatch  # [T,1]
+
+        # remove the popped slot — gated like the XLA pop (enable=active):
+        # a budget-cut event is still consumed even though nothing else
+        # is written (core.step_one pops with enable=active, not take)
+        rm = sel & (active & found)
+        qthi = jnp.where(rm, jnp.int32(_INV_HI), qthi)
+        qtlo = jnp.where(rm, jnp.int32(_INV_LO_B), qtlo)
+
+        # ---- handler (probe workload, bit-identical to _probe_handle) ----
+        node = pay[:, 0:1]
+        acc_n = _i(_u(acc) + (w[:, 2:3] ^ w[:, 3:4]))
+        idx = acc_n & jnp.int32(_L - 1)
+        rmask = (ring_iota == node * _L + idx) & take
+        ring_n = jnp.where(rmask, _i(w[:, 4:5]), ring)
+        nsent_n = jnp.where(take, nsent + 1, nsent)
+
+        delay = _mulhi32(w[:, 5:6], _DELAY_HI - _DELAY_LO) + jnp.uint32(_DELAY_LO)
+        next_node = _i(_mulhi32(w[:, 6:7], _N))
+        eth, etl = _add64_u32(nh, nl, delay)
+
+        # ---- push the re-arm event at the first free slot ----
+        free = (qthi == _INV_HI) & (qtlo == _INV_LO_B)
+        ffirst = jnp.min(jnp.where(free, q_iota_i, jnp.int32(qp)), axis=1,
+                         keepdims=True)
+        wmask = (q_iota_i == ffirst) & take  # first-free one-hot
+        qthi = jnp.where(wmask, eth, qthi)
+        qtlo = jnp.where(wmask, etl, qtlo)
+        qkind = jnp.where(wmask, jnp.int32(0), qkind)
+        # payload write without .at[].set (Mosaic has no scatter): select
+        # the new [P]-column by plane-index iota
+        p_iota = jax.lax.broadcasted_iota(jnp.int32, qpay.shape, 1)
+        newpay = jnp.where(
+            p_iota == 0, next_node[:, None, :],
+            jnp.where(p_iota == 1, _i(w[:, 7:8])[:, None, :], jnp.int32(0)),
+        )
+        qpay = jnp.where(wmask[:, None, :], newpay, qpay)
+        # any(free) via the first-free index (jnp.any's reduce_or crashes
+        # this Mosaic backend); ffirst == qp means no free slot
+        have_room = ffirst < jnp.int32(qp)
+        ov_n = ov | (take & ~have_room)
+
+        # occupancy count as a float32 sum (exact for <= 2^24 slots; the
+        # int32 sum would hit the same Mosaic int64 promotion)
+        qsize = jnp.sum(
+            (~((qthi == _INV_HI) & (qtlo == _INV_LO_B))).astype(jnp.float32),
+            axis=1, keepdims=True,
+        ).astype(jnp.int32)
+        qmax_n = jnp.maximum(qmax, qsize)
+
+        now_hi2 = jnp.where(take, nh, now_hi)
+        now_lob2 = jnp.where(take, nl, now_lob)
+        ctr_n = jnp.where(take, ctr + 1, ctr)
+        done_n = done | (active & (~found | time_up)).astype(jnp.int32)
+        ring2 = ring_n
+        acc2 = jnp.where(take, acc_n, acc)
+
+        return (qthi, qtlo, qkind, qpay, now_hi2, now_lob2, ctr_n, done_n,
+                ov_n, qmax_n, ring2, acc2, nsent_n)
+
+    carry = (qthi, qtlo, qkind, qpay, now_hi, now_lob, ctr, done, ov, qmax,
+             ring, acc, nsent)
+    carry = jax.lax.fori_loop(0, steps, body, carry)
+    (qthi, qtlo, qkind, qpay, now_hi, now_lob, ctr, done, ov, qmax,
+     ring, acc, nsent) = carry
+
+    qthi_o[:] = qthi
+    qtlo_o[:] = qtlo
+    qkind_o[:] = qkind
+    qpay_o[:] = qpay
+    key_o[:] = key_r[:]
+    now_o[:, 0:1] = now_hi
+    now_o[:, 1:2] = now_lob
+    ctr_o[:] = ctr
+    done_o[:] = done
+    ov_o[:] = ov
+    qmax_o[:] = qmax
+    ring_o[:] = ring
+    acc_o[:] = acc
+    nsent_o[:] = nsent
+
+
+@partial(jax.jit, static_argnames=("steps", "time_limit", "tile", "interpret"))
+def run_megasweep(state: EngineState, steps: int,
+                  time_limit: int = 1 << 62, tile: int = 256,
+                  interpret: bool = False) -> EngineState:
+    """Advance a batched probe-workload state ``steps`` events per seed
+    entirely inside the megakernel; returns the same ``EngineState``
+    structure as the XLA driver (bit-identical, asserted by the tests)."""
+    from jax.experimental import pallas as pl
+
+    S = state.seed.shape[0]
+    if S % tile:
+        raise ValueError(f"batch {S} must be a multiple of tile {tile}")
+    qn = state.queue.time.shape[1]
+    qp = qn  # Mosaic pads lanes internally; keep logical width
+
+    qthi, qtlo = _split64(state.queue.time)
+    key = jax.random.key_data(state.key).astype(jnp.uint32).astype(jnp.int32)
+    nh, nl = _split64(state.now_ns)
+    now2 = jnp.stack([nh, nl], axis=1)
+    w: _ProbeW = state.wstate
+
+    ins = [
+        qthi, qtlo, state.queue.kind,
+        jnp.swapaxes(state.queue.pay, 1, 2),  # [S, P, Q] slot-major
+        key, now2,
+        state.ctr.astype(jnp.int32).reshape(S, 1),
+        state.done.astype(jnp.int32).reshape(S, 1),
+        state.overflow.astype(jnp.int32).reshape(S, 1),
+        # qmax is int64 in the XLA state (x64 sum); values fit int32
+        state.qmax.astype(jnp.int32).reshape(S, 1),
+        w.ring.reshape(S, _N * _L),
+        w.acc.reshape(S, 1),
+        w.nsent.reshape(S, 1),
+    ]
+    row2 = lambda i: (i, jnp.int32(0))  # noqa: E731
+    row3 = lambda i: (i, jnp.int32(0), jnp.int32(0))  # noqa: E731
+
+    # one tile per pallas_call: XLA stages each call's operand AND result
+    # tuples in scoped VMEM (~2x the tile state; a 4096-seed call OOMs the
+    # 16 MB budget), which is exactly the residency the megakernel wants —
+    # the tile lives in VMEM for all `steps` events, and the HBM round
+    # trip happens once per call, not per event. lax.map sequences tiles
+    # through ONE compiled kernel instance.
+    chunk = min(S, tile)
+
+    def spec(a):
+        if a.ndim == 3:
+            return pl.BlockSpec((tile, a.shape[1], a.shape[2]), row3)
+        return pl.BlockSpec((tile, a.shape[1]), row2)
+
+    def call(chunk_ins):
+        in_specs = [spec(a) for a in chunk_ins]
+        out_shape = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in chunk_ins]
+        return pl.pallas_call(
+            partial(_mega_kernel, steps, time_limit, qp),
+            grid=(chunk // tile,),
+            in_specs=in_specs,
+            out_specs=in_specs,
+            out_shape=out_shape,
+            input_output_aliases={i: i for i in range(len(chunk_ins))},
+            interpret=interpret,
+        )(*chunk_ins)
+
+    if S == chunk:
+        outs = call(ins)
+    else:
+        stacked = [a.reshape(S // chunk, chunk, *a.shape[1:]) for a in ins]
+        outs = jax.lax.map(lambda xs: tuple(call(list(xs))), tuple(stacked))
+        outs = [a.reshape(S, *a.shape[2:]) for a in outs]
+
+    (qthi, qtlo, qkind, qpay, key_o, now2, ctr, done, ov, qmax,
+     ring, acc, nsent) = outs
+    return EngineState(
+        seed=state.seed,
+        key=state.key,
+        now_ns=_join64(now2[:, 0], now2[:, 1]),
+        ctr=ctr[:, 0].astype(state.ctr.dtype),
+        done=done[:, 0].astype(bool),
+        overflow=ov[:, 0].astype(bool),
+        qmax=qmax[:, 0].astype(state.qmax.dtype),
+        queue=equeue.EventQueue(
+            time=_join64(qthi, qtlo),
+            kind=qkind,
+            pay=jnp.swapaxes(qpay, 1, 2),
+            valid=_join64(qthi, qtlo) != INVALID_TIME,
+        ),
+        wstate=_ProbeW(
+            ring=ring.reshape(S, _N, _L),
+            acc=acc[:, 0],
+            nsent=nsent[:, 0],
+        ),
+    )

@@ -39,7 +39,8 @@
 /* interned attribute / method names (module-lifetime) */
 static PyObject *s_wake, *s_subscribe, *s_scheduled, *s_finished, *s_cancelled,
     *s_node, *s_killed, *s_paused, *s_paused_tasks, *s_coro, *s_task,
-    *s__drop_task, *s__complete, *s__poll_raised, *s_ns, *s__ready_items;
+    *s__drop_task, *s__complete, *s__poll_raised, *s_ns, *s__ready_items,
+    *s_time_limit_ns, *s__raise_time_limit;
 
 static PyObject *instant_cls = NULL; /* set by _configure() from time.py */
 
@@ -1274,16 +1275,13 @@ static PyObject *
 loop_run(LoopObj *self, PyObject *args)
 {
     /* the block_on inner loop (ref task/mod.rs:220-260): drain ready,
-     * check main, jump to the next timer; raises the Python-provided
-     * exception types on deadlock / time-limit */
+     * check main, jump to the next timer.  The time limit is RE-READ from
+     * the executor each iteration (not snapshotted) so a mid-sim
+     * set_time_limit behaves identically to the Python loop. */
     PyObject *main_join;        /* a Future (JoinHandle) */
     PyObject *deadlock_exc;     /* exception CLASS for deadlock */
-    PyObject *timelimit_exc;    /* exception CLASS for time limit */
-    long long time_limit = -1;  /* <0 = no limit */
     long long epsilon = 50;
-    PyObject *tl_msg = NULL;    /* prebuilt time-limit message */
-    if (!PyArg_ParseTuple(args, "OOO|LLO", &main_join, &deadlock_exc,
-                          &timelimit_exc, &time_limit, &epsilon, &tl_msg))
+    if (!PyArg_ParseTuple(args, "OO|L", &main_join, &deadlock_exc, &epsilon))
         return NULL;
     if (!PyObject_TypeCheck(main_join, &Future_Type)) {
         PyErr_SetString(PyExc_TypeError, "main_join must be a Future");
@@ -1314,10 +1312,29 @@ loop_run(LoopObj *self, PyObject *args)
             timers->clock_ns = jumped;
         if (timers_fire_due_impl(timers) < 0)
             return NULL;
-        if (time_limit >= 0 && timers->clock_ns > time_limit) {
-            PyErr_SetObject(timelimit_exc,
-                            tl_msg != NULL ? tl_msg : Py_None);
+        PyObject *limit = PyObject_GetAttr(self->executor, s_time_limit_ns);
+        if (limit == NULL)
             return NULL;
+        if (limit != Py_None) {
+            long long lim = PyLong_AsLongLong(limit);
+            Py_DECREF(limit);
+            if (lim == -1 && PyErr_Occurred())
+                return NULL;
+            if (timers->clock_ns > lim) {
+                /* the helper raises TimeLimitError with the formatted
+                 * message the Python loop produces */
+                PyObject *r = PyObject_CallMethodNoArgs(
+                    self->executor, s__raise_time_limit);
+                if (r != NULL) { /* helper must raise */
+                    Py_DECREF(r);
+                    PyErr_SetString(PyExc_RuntimeError,
+                                    "_raise_time_limit did not raise");
+                }
+                return NULL;
+            }
+        }
+        else {
+            Py_DECREF(limit);
         }
     }
 }
@@ -1459,6 +1476,8 @@ PyInit__simloop(void)
     s__log = PyUnicode_InternFromString("_log");
     s__check = PyUnicode_InternFromString("_check");
     s__ready_items = PyUnicode_InternFromString("_ready_items");
+    s_time_limit_ns = PyUnicode_InternFromString("time_limit_ns");
+    s__raise_time_limit = PyUnicode_InternFromString("_raise_time_limit");
 
     if (PyType_Ready(&Future_Type) < 0 ||
         PyType_Ready(&TimerEntry_Type) < 0 || PyType_Ready(&Timers_Type) < 0 ||

@@ -25,8 +25,44 @@ from typing import (
     Union,
 )
 
+import gc as _gc
+import threading as _threading
+
 from . import context
 from .config import Config
+
+# Relaxed gen-0 cycle-GC threshold while any sim runs: the executor
+# allocates mostly-acyclic objects at event rate, and collection timing
+# cannot affect schedules (no draws, no sim state), only wall-clock.
+# Refcounted so concurrent block_on calls (the one-thread-per-seed sweep
+# pattern) don't snapshot each other's raised threshold and leak it; the
+# original is restored when the LAST sim exits. Threshold 0 (embedder
+# disabled GC) is left alone.
+_gc_lock = _threading.Lock()
+_gc_depth = 0
+_gc_saved: "tuple | None" = None
+
+
+def _gc_relax() -> None:
+    global _gc_depth, _gc_saved
+    with _gc_lock:
+        _gc_depth += 1
+        if _gc_depth == 1:
+            t = _gc.get_threshold()
+            if t[0] > 0:
+                _gc_saved = t
+                _gc.set_threshold(max(t[0], 50_000), *t[1:])
+            else:
+                _gc_saved = None
+
+
+def _gc_restore() -> None:
+    global _gc_depth, _gc_saved
+    with _gc_lock:
+        _gc_depth -= 1
+        if _gc_depth == 0 and _gc_saved is not None:
+            _gc.set_threshold(*_gc_saved)
+            _gc_saved = None
 from .futures import JoinHandle
 from .metrics import RuntimeMetrics
 from .plugin import Simulator
@@ -258,22 +294,14 @@ class Runtime:
         coro = main() if callable(main) and not inspect.iscoroutine(main) else main
         assert inspect.iscoroutine(coro), "block_on expects a coroutine"
         allow_thread = getattr(self, "_allow_system_thread", False)
-        # Relax the gen-0 cycle-GC threshold for the duration of the sim:
-        # the executor allocates mostly-acyclic objects at event rate, and
-        # collection timing cannot affect schedules (no draws, no sim
-        # state), only wall-clock. Restored on exit.
-        import gc
-
-        thresholds = gc.get_threshold()
-        if thresholds[0] > 0:  # 0 = embedder disabled GC; leave it off
-            gc.set_threshold(max(thresholds[0], 50_000), *thresholds[1:])
+        _gc_relax()
         try:
             with context.enter_handle(self.handle), interposed(
                 self.handle, allow_system_thread=allow_thread
             ):
                 return self.executor.block_on(coro)
         finally:
-            gc.set_threshold(*thresholds)
+            _gc_restore()
 
     @staticmethod
     def check_determinism(

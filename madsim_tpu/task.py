@@ -269,19 +269,11 @@ class Executor:
         (ref ``Executor::block_on``, task/mod.rs:220-260)."""
         main = self.spawn_on(self.main_node, coro, name="main", spawn_site="main")
         if self._cloop is not None:
-            # the whole inner loop is compiled (ref task/mod.rs:220-260)
-            limit = self.time_limit_ns
-            return self._cloop.run(
-                main,
-                DeadlockError,
-                TimeLimitError,
-                -1 if limit is None else limit,
-                50,  # _JUMP_EPSILON_NS
-                None if limit is None else (
-                    f"simulated time limit exceeded "
-                    f"({limit / 1e9:.3f}s of virtual time)"
-                ),
-            )
+            # the whole inner loop is compiled (ref task/mod.rs:220-260);
+            # it re-reads self.time_limit_ns each iteration and raises via
+            # _raise_time_limit, so mid-sim set_time_limit behaves exactly
+            # like the Python loop below
+            return self._cloop.run(main, DeadlockError, 50)
         while True:
             self.run_all_ready()
             if main.done():
@@ -390,6 +382,14 @@ class Executor:
         """Task coroutine returned ``value`` (the StopIteration branch)."""
         self._finish(task)
         task.join.set_result(value)
+
+    def _raise_time_limit(self) -> None:
+        """Raise the TimeLimitError the Python loop would (called by the
+        compiled loop when the clock passes ``time_limit_ns``)."""
+        raise TimeLimitError(
+            f"simulated time limit exceeded "
+            f"({self.time_limit_ns / 1e9:.3f}s of virtual time)"
+        )
 
     def _poll_raised(self, task: Task, exc: BaseException) -> bool:
         """Exception out of a poll; returns False to propagate (the

@@ -155,3 +155,61 @@ def test_simloop_check_determinism_still_works():
             ms.rand.gen_range(0, 10)
 
     Builder(seed=3, count=2, check_determinism=True).run(wl)
+
+
+def test_simloop_mid_sim_time_limit_change_honored():
+    """set_time_limit from inside the sim must behave identically on the
+    compiled and pure-Python loops (the C loop re-reads the limit each
+    iteration instead of snapshotting it)."""
+    script = (
+        "import sys; sys.path.insert(0, '/root/repo');"
+        "import madsim_tpu as ms;"
+        "from madsim_tpu.task import TimeLimitError\n"
+        "rt = ms.Runtime(seed=5)\n"
+        "async def main():\n"
+        "    rt.set_time_limit(0.25)\n"
+        "    await ms.sleep(100.0)\n"
+        "try:\n"
+        "    rt.block_on(main())\n"
+        "    print('no-error')\n"
+        "except TimeLimitError as e:\n"
+        "    print(str(e))\n"
+    )
+    outs = []
+    for env_extra in ({}, {"MADSIM_NO_NATIVE": "1"}):
+        env = dict(os.environ, **env_extra)
+        r = subprocess.run(
+            ["python", "-c", script], capture_output=True, text=True, env=env,
+            timeout=120,
+        )
+        assert r.returncode == 0, r.stderr
+        outs.append(r.stdout)
+    assert outs[0] == outs[1]
+    assert "time limit exceeded" in outs[0]
+
+
+def test_gc_threshold_restored_across_threads():
+    """Concurrent block_on calls must not leak the relaxed GC threshold
+    (refcounted raise/restore in runtime.py)."""
+    import gc
+    import threading
+
+    import madsim_tpu as ms
+
+    base = gc.get_threshold()
+
+    def run(seed):
+        rt = ms.Runtime(seed=seed)
+
+        async def m():
+            for _ in range(20):
+                await ms.sleep(0.01)
+
+        rt.block_on(m())
+
+    ts = [threading.Thread(target=run, args=(i,)) for i in range(3)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    assert gc.get_threshold() == base
