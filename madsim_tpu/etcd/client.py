@@ -13,7 +13,7 @@ from typing import Any, List, Optional, Sequence, Tuple
 
 from .. import rand as msrand
 from ..grpc.status import Status
-from ..net.endpoint import connect1_ephemeral
+from ..net.endpoint import connect1_ephemeral, exchange1
 from .service import (
     DeleteOptions,
     Event,
@@ -231,13 +231,9 @@ class Client:
     async def _call(self, req: tuple) -> Any:
         tx, rx = await self._open()
         try:
-            await tx.send(req)
-            tx.close()
-            rsp = await rx.recv()
+            rsp = await exchange1(tx, rx, req)
         except (BrokenPipeError, ConnectionResetError) as e:
             raise Status.unavailable(f"etcd transport error: {e}") from None
-        finally:
-            rx.close()  # one-shot exchange; frees the real-mode socket
         if rsp is None:
             raise Status.unavailable("etcd connection closed")
         kind, payload = rsp

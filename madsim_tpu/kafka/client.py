@@ -15,7 +15,7 @@ from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional, Tuple, Type, TypeVar
 
 from .. import time as mstime
-from ..net.endpoint import connect1_ephemeral
+from ..net.endpoint import connect1_ephemeral, exchange1
 from .broker import OwnedMessage, Watermarks
 
 T = TypeVar("T")
@@ -54,6 +54,9 @@ class ClientConfig:
 class _BrokerConn:
     """One request/response exchange per operation (sim_broker protocol)."""
 
+    # transport hook — real/kafka.py dials framed TCP instead
+    _connect = staticmethod(connect1_ephemeral)
+
     def __init__(self, config: ClientConfig):
         servers = config.get("bootstrap.servers")
         if not servers:
@@ -62,10 +65,8 @@ class _BrokerConn:
 
     async def call(self, req: tuple) -> Any:
         try:
-            tx, rx = await connect1_ephemeral(self._addr)
-            await tx.send(req)
-            tx.close()
-            rsp = await rx.recv()
+            tx, rx = await self._connect(self._addr)
+            rsp = await exchange1(tx, rx, req)
         except (ConnectionError, OSError) as e:
             raise KafkaError(f"broker transport error: {e}") from None
         if rsp is None:
@@ -112,8 +113,10 @@ FutureRecord = BaseRecord  # same shape; only the send path differs
 class BaseProducer:
     """Buffers records locally until ``flush`` (sim producer semantics)."""
 
+    _conn_cls = _BrokerConn  # real/kafka.py overrides
+
     def __init__(self, config: ClientConfig):
-        self._conn = _BrokerConn(config)
+        self._conn = self._conn_cls(config)
         self._buffer: List[BaseRecord] = []
 
     def send(self, record: BaseRecord) -> None:
@@ -137,15 +140,18 @@ class FutureProducer:
     """Per-record async send returning (partition, offset); honors a
     ``linger.ms`` batching delay on virtual time."""
 
+    _conn_cls = _BrokerConn  # real/kafka.py overrides
+    _sleep = staticmethod(mstime.sleep)
+
     def __init__(self, config: ClientConfig):
-        self._conn = _BrokerConn(config)
+        self._conn = self._conn_cls(config)
         self._linger_s = config.get_float("linger.ms", 0.0) / 1000.0
 
     async def send(
         self, record: BaseRecord, _queue_timeout_s: float = 0.0
     ) -> Tuple[int, int]:
         if self._linger_s > 0:
-            await mstime.sleep(self._linger_s)
+            await self._sleep(self._linger_s)
         return tuple(
             await self._conn.call(
                 ("produce", record.topic, record.partition, record.key, record.payload)
@@ -184,8 +190,12 @@ class BaseConsumer:
 
     POLL_TICK_S = 0.01
 
+    _conn_cls = _BrokerConn  # real/kafka.py overrides
+    _sleep = staticmethod(mstime.sleep)
+    _now_instant = staticmethod(mstime.now_instant)
+
     def __init__(self, config: ClientConfig):
-        self._conn = _BrokerConn(config)
+        self._conn = self._conn_cls(config)
         self._fetch_max = config.get_int("fetch.max.bytes", 52_428_800)
         self._partition_max = config.get_int("max.partition.fetch.bytes", 1_048_576)
         self._assignments: List[_Assignment] = []
@@ -244,16 +254,16 @@ class BaseConsumer:
         self._rr = (self._rr + 1) % n
 
     async def poll(self, timeout_s: float = 1.0) -> Optional[OwnedMessage]:
-        deadline = mstime.now_instant() + timeout_s
+        deadline = self._now_instant() + timeout_s
         while True:
             if self._buffer:
                 return self._buffer.pop(0)
             await self._fetch_round()
             if self._buffer:
                 return self._buffer.pop(0)
-            if mstime.now_instant() >= deadline:
+            if self._now_instant() >= deadline:
                 return None
-            await mstime.sleep(self.POLL_TICK_S)
+            await self._sleep(self.POLL_TICK_S)
 
     async def fetch_watermarks(
         self, topic: str, partition: int, _timeout_s: float = 1.0
@@ -301,8 +311,10 @@ class NewTopic:
 
 
 class AdminClient:
+    _conn_cls = _BrokerConn  # real/kafka.py overrides
+
     def __init__(self, config: ClientConfig):
-        self._conn = _BrokerConn(config)
+        self._conn = self._conn_cls(config)
 
     async def create_topics(self, topics: List[NewTopic]) -> List[Optional[str]]:
         """Returns per-topic error strings (None = success), like the

@@ -16,14 +16,32 @@ from .broker import Broker, KafkaBrokerError
 
 
 class SimBroker:
+    # executor/clock bindings as class attributes so the real-mode twin
+    # (real/kafka.py) rebinds them to asyncio + the wall clock while
+    # reusing the whole request dispatcher (the sim/std split of
+    # madsim-rdkafka/src/lib.rs:3-12)
+    _spawn = staticmethod(mstask.spawn)
+
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        return await NetEndpoint.bind(addr)
+
+    @staticmethod
+    def _now_ms() -> int:
+        return current_handle().time.now_time_ns() // 1_000_000
+
     def __init__(self) -> None:
         self.broker = Broker()
+        #: set once the listener is bound (port-0 discovery, real mode)
+        self.bound_addr: "tuple | None" = None
 
     async def serve(self, addr: "str | tuple") -> None:
-        ep = await NetEndpoint.bind(addr)
+        ep = await self._bind(addr)
+        local = getattr(ep, "local_addr", None)
+        self.bound_addr = local() if callable(local) else None
         while True:
             tx, rx, _src = await ep.accept1()
-            mstask.spawn(self._serve_conn(tx, rx), name="kafka-conn")
+            self._spawn(self._serve_conn(tx, rx), name="kafka-conn")
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
         try:
@@ -51,8 +69,7 @@ class SimBroker:
             return None
         if op == "produce":
             _, topic, partition, key, payload = req
-            ts_ms = current_handle().time.now_time_ns() // 1_000_000
-            return b.produce(topic, partition, key, payload, ts_ms)
+            return b.produce(topic, partition, key, payload, self._now_ms())
         if op == "fetch":
             _, topic, partition, offset, fmax, pmax = req
             return b.fetch(topic, partition, offset, fmax, pmax)

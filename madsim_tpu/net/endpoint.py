@@ -282,6 +282,22 @@ async def connect1_ephemeral(dst: "str | Addr") -> Tuple[PipeSender, PipeReceive
         ep.close()
 
 
+async def exchange1(tx: Any, rx: Any, req: Any) -> Any:
+    """One request/response over a freshly opened connection pair: send,
+    half-close the sender, await the single reply. The receiver half is
+    ALWAYS closed — in real mode that frees the socket; in sim it marks
+    the pipe closed (harmless). Returns the reply, or ``None`` if the
+    peer closed without answering. The one-shot exchange discipline shared
+    by the etcd / kafka / s3 client call paths (each maps transport errors
+    to its own error type)."""
+    try:
+        await tx.send(req)
+        tx.close()
+        return await rx.recv()
+    finally:
+        rx.close()
+
+
 async def lookup_host(addr: "str | Addr") -> List[Addr]:
     """Resolve a host:port through simulated DNS
     (ref ``lookup_host``, net/addr.rs:33-360)."""

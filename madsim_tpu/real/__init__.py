@@ -14,9 +14,13 @@ against madsim_tpu runs unmodified against a real network:
 Provided: ``Runtime.block_on``, ``spawn``, ``sleep``/``timeout``/
 ``interval``/``Instant``, tag-matching ``Endpoint`` (UDP datagrams) and
 ``TcpEndpoint`` (length-delimited frames over persistent connections, the
-reference std transport's shape), and the built-in RPC (``call`` /
-``add_rpc_handler``) on either. Frames use the restricted binary codec
-(real/codec.py) — never pickle, so a hostile peer cannot execute code.
+reference std transport's shape), the built-in RPC (``call`` /
+``add_rpc_handler``) on either, and real-mode twins of ALL FOUR ecosystem
+shims — ``real.grpc`` (the same @service classes over framed TCP),
+``real.etcd``, ``real.kafka``, ``real.s3`` (the unchanged client APIs
+against the framework's own state machines on real sockets). Frames use
+the restricted binary codec (real/codec.py) — never pickle, so a hostile
+peer cannot execute code.
 Randomness is real randomness; there is no determinism in real mode
 (matching the reference, where buggify is a no-op and seeds don't exist,
 std/buggify.rs:6-30).
@@ -29,6 +33,8 @@ from . import codec
 from . import stream
 from . import grpc
 from . import etcd
+from . import kafka
+from . import s3
 
 __all__ = [
     "Endpoint",
@@ -36,6 +42,8 @@ __all__ = [
     "codec",
     "etcd",
     "grpc",
+    "kafka",
+    "s3",
     "stream",
     "Instant",
     "Runtime",

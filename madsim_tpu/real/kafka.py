@@ -1,0 +1,105 @@
+"""Real-mode Kafka twin: the unchanged client API + the broker state
+machine over real TCP.
+
+The reference's madsim-rdkafka compiles to the *real* rdkafka bindings
+without ``--cfg madsim`` (madsim-rdkafka/src/lib.rs:3-12). No librdkafka
+exists in this image, so real mode pairs the unchanged client surface
+(producers, consumers, admin) with the framework's own ``Broker`` served
+over real sockets — one framed TCP exchange per operation, wall-clock
+produce timestamps and poll deadlines::
+
+    from madsim_tpu.real import kafka
+
+    await kafka.SimBroker().serve(("127.0.0.1", 9092))      # server task
+    p = await config.create(kafka.FutureProducer)           # client side
+"""
+
+from __future__ import annotations
+
+from typing import Any
+import time as _walltime
+
+from ..kafka.broker import OwnedMessage, Watermarks
+from ..kafka.client import (
+    AdminClient as _SimAdminClient,
+    BaseConsumer as _SimBaseConsumer,
+    BaseProducer as _SimBaseProducer,
+    BaseRecord,
+    ClientConfig,
+    FutureProducer as _SimFutureProducer,
+    FutureRecord,
+    KafkaError,
+    StreamConsumer as _SimStreamConsumer,
+    TopicPartitionList,
+    _BrokerConn as _SimBrokerConn,
+)
+from ..kafka.server import SimBroker as _SimBroker
+from . import codec, stream
+from . import time as rtime
+from .runtime import spawn
+
+# the wire vocabulary (responses carry these dataclasses)
+codec.register(OwnedMessage)
+codec.register(Watermarks)
+
+
+class SimBroker(_SimBroker):
+    """The broker dispatcher on a real listener, wall-clock timestamps."""
+
+    _spawn = staticmethod(spawn)
+
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        return await stream.StreamListener.bind(addr)
+
+    @staticmethod
+    def _now_ms() -> int:
+        return _walltime.time_ns() // 1_000_000
+
+
+Broker = SimBroker  # the natural real-mode name
+
+
+class _BrokerConn(_SimBrokerConn):
+    _connect = staticmethod(stream.connect)
+
+
+class BaseProducer(_SimBaseProducer):
+    _conn_cls = _BrokerConn
+
+
+class FutureProducer(_SimFutureProducer):
+    _conn_cls = _BrokerConn
+    _sleep = staticmethod(rtime.sleep)
+
+
+class BaseConsumer(_SimBaseConsumer):
+    _conn_cls = _BrokerConn
+    _sleep = staticmethod(rtime.sleep)
+    _now_instant = staticmethod(rtime.now_instant)
+
+
+class StreamConsumer(_SimStreamConsumer, BaseConsumer):
+    pass
+
+
+class AdminClient(_SimAdminClient):
+    _conn_cls = _BrokerConn
+
+
+__all__ = [
+    "AdminClient",
+    "BaseConsumer",
+    "BaseProducer",
+    "BaseRecord",
+    "Broker",
+    "ClientConfig",
+    "FutureProducer",
+    "FutureRecord",
+    "KafkaError",
+    "OwnedMessage",
+    "SimBroker",
+    "StreamConsumer",
+    "TopicPartitionList",
+    "Watermarks",
+]

@@ -11,7 +11,7 @@ from __future__ import annotations
 from dataclasses import dataclass, field
 from typing import Any, Dict, List, Optional
 
-from ..net.endpoint import connect1_ephemeral
+from ..net.endpoint import connect1_ephemeral, exchange1
 from .service import S3Error
 
 
@@ -388,20 +388,21 @@ class Client:
     def __init__(self, addr: str):
         self._addr = addr
 
-    @staticmethod
-    def from_addr(addr: str) -> "Client":
-        return Client(addr)
+    @classmethod
+    def from_addr(cls, addr: str) -> "Client":
+        return cls(addr)
 
-    @staticmethod
-    def from_conf(conf: Dict[str, Any]) -> "Client":
-        return Client(conf["endpoint"])
+    @classmethod
+    def from_conf(cls, conf: Dict[str, Any]) -> "Client":
+        return cls(conf["endpoint"])
+
+    # transport hook — real/s3.py dials framed TCP instead
+    _connect = staticmethod(connect1_ephemeral)
 
     async def _call(self, req: tuple) -> Any:
         try:
-            tx, rx = await connect1_ephemeral(self._addr)
-            await tx.send(req)
-            tx.close()
-            rsp = await rx.recv()
+            tx, rx = await self._connect(self._addr)
+            rsp = await exchange1(tx, rx, req)
         except (ConnectionError, OSError) as e:
             raise S3Error("TransportError", str(e)) from None
         if rsp is None:

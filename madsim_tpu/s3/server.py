@@ -15,14 +15,27 @@ from .service import S3Error, S3Service
 
 
 class SimServer:
+    # executor/clock bindings as class attributes so the real-mode twin
+    # (real/s3.py) rebinds them to asyncio + the wall clock while reusing
+    # the dispatcher (the sim/std split of madsim-aws-sdk-s3/src/lib.rs)
+    _spawn = staticmethod(mstask.spawn)
+
+    @staticmethod
+    async def _bind(addr: "str | tuple") -> Any:
+        return await NetEndpoint.bind(addr)
+
     def __init__(self, service: "S3Service | None" = None) -> None:
         self.service = service or S3Service()
+        #: set once the listener is bound (port-0 discovery, real mode)
+        self.bound_addr: "tuple | None" = None
 
     async def serve(self, addr: "str | tuple") -> None:
-        ep = await NetEndpoint.bind(addr)
+        ep = await self._bind(addr)
+        local = getattr(ep, "local_addr", None)
+        self.bound_addr = local() if callable(local) else None
         while True:
             tx, rx, _src = await ep.accept1()
-            mstask.spawn(self._serve_conn(tx, rx), name="s3-conn")
+            self._spawn(self._serve_conn(tx, rx), name="s3-conn")
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
         try:

@@ -65,19 +65,17 @@ def main() -> None:
     for S in BATCHES:
         xla = lambda s0: jax.block_until_ready(core._drive(wl, cfg, s0))  # noqa: E731
 
-        # correctness first: one bit-exact comparison per batch size
-        s0 = core._init(wl, cfg, fresh_seeds(S))
-        ref = core._drive(wl, cfg, s0)
-        got = mk.run_megasweep(s0, steps=STEPS,
-                               time_limit=cfg.time_limit_ns, tile=256)
-        leaves = jax.tree.leaves(
-            jax.tree.map(lambda a, b: bool(jnp.array_equal(a, b)), ref, got)
-        )
-        assert all(leaves), f"megakernel diverged at S={S}: {leaves}"
+        # one fixed verification batch per size: EVERY tile that gets
+        # timed must first reproduce the XLA driver's final state
+        # bit-exactly on it (a tile-size-dependent miscompile must not
+        # publish a timing as verified); the comparison doubles as the
+        # warmup/compile call
+        s_verify = core._init(wl, cfg, fresh_seeds(S))
+        ref = core._drive(wl, cfg, s_verify)
 
-        # contenders, warmed up once each; then INTERLEAVED reps — the
-        # tunneled device drifts ±30% over minutes, so only alternating
-        # measurements in one process compare fairly (min-of-reps)
+        # contenders, then INTERLEAVED reps — the tunneled device drifts
+        # ±30% over minutes, so only alternating measurements in one
+        # process compare fairly (min-of-reps)
         contenders = {"xla": xla}
         for tile in TILES:
             if S % tile:
@@ -86,13 +84,22 @@ def main() -> None:
                 s0, steps=STEPS, time_limit=cfg.time_limit_ns, tile=t
             )
             try:
-                s0 = core._init(wl, cfg, fresh_seeds(S))
-                timed(mega, s0)  # warmup / compile
-                contenders[f"mega{tile}"] = mega
+                got = mega(s_verify)
             except Exception as e:  # e.g. a tile too big for scoped VMEM
                 print(json.dumps({"batch": S, "tile": tile,
                                   "skipped": str(e).splitlines()[0][:120]}),
                       file=sys.stderr)
+                continue
+            leaves = jax.tree.leaves(
+                jax.tree.map(lambda a, b: bool(jnp.array_equal(a, b)), ref, got)
+            )
+            assert all(leaves), f"megakernel diverged at S={S} tile={tile}"
+            contenders[f"mega{tile}"] = mega
+        if len(contenders) == 1:
+            print(json.dumps({"batch": S,
+                              "skipped": "no megakernel tile compiled"}),
+                  file=sys.stderr)
+            continue
         s0 = core._init(wl, cfg, fresh_seeds(S))
         timed(xla, s0)  # warmup
         times = {name: [] for name in contenders}
