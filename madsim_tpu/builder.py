@@ -1,11 +1,13 @@
 """Seed-sweep test driver (ref madsim/src/sim/runtime/builder.rs:7-162).
 
-Reads ``MADSIM_TEST_{SEED,NUM,JOBS,CONFIG,TIME_LIMIT,CHECK_DETERMINISM}`` and
-``MADSIM_ALLOW_SYSTEM_THREAD`` from the environment, runs ``count`` seeds
-(seed, seed+1, ...) with ``jobs`` concurrent OS threads (one fresh thread per
-seed, like the reference's ``std::thread::spawn`` + ``buffer_unordered``),
-and on failure prints the reproducing ``MADSIM_TEST_SEED`` (ref
-runtime/mod.rs:205-210).
+Reads ``MADSIM_TEST_{SEED,NUM,JOBS,PROCS,CONFIG,TIME_LIMIT,
+CHECK_DETERMINISM}`` and ``MADSIM_ALLOW_SYSTEM_THREAD`` from the
+environment, runs ``count`` seeds (seed, seed+1, ...) with ``jobs``
+concurrent OS threads (one fresh thread per seed, like the reference's
+``std::thread::spawn`` + ``buffer_unordered``) or — for CPU-bound sweeps
+that Python threads would GIL-serialize — ``procs`` forked worker
+processes, and on failure prints the reproducing ``MADSIM_TEST_SEED``
+(ref runtime/mod.rs:205-210).
 
 The ``@sim_test`` decorator is the analogue of ``#[madsim::test]``
 (madsim-macros/src/lib.rs:88-152): it rewrites an async test into a sync
@@ -44,6 +46,7 @@ class Builder:
         seed: Optional[int] = None,
         count: int = 1,
         jobs: int = 1,
+        procs: int = 1,
         config: Optional[Config] = None,
         time_limit: Optional[float] = None,
         check_determinism: bool = False,
@@ -56,6 +59,7 @@ class Builder:
         self.seed = seed
         self.count = count
         self.jobs = jobs
+        self.procs = procs
         self.config = config
         self.time_limit = time_limit
         self.check_determinism = check_determinism
@@ -73,6 +77,7 @@ class Builder:
             seed=_env_int("MADSIM_TEST_SEED"),
             count=_env_int("MADSIM_TEST_NUM") or 1,
             jobs=_env_int("MADSIM_TEST_JOBS") or 1,
+            procs=_env_int("MADSIM_TEST_PROCS") or 1,
             config=cfg,
             time_limit=(
                 float(os.environ["MADSIM_TEST_TIME_LIMIT"])
@@ -99,6 +104,8 @@ class Builder:
     def run(self, test_fn: Callable[[], Coroutine]) -> Any:
         """Run the async test over ``count`` seeds (ref builder.rs:120-161)."""
         seeds = list(range(self.seed, self.seed + self.count))
+        if self.procs > 1 and self.count > 1:
+            return self._run_procs(seeds, test_fn)
         if self.jobs <= 1 or self.count == 1:
             last = None
             for seed in seeds:
@@ -145,6 +152,119 @@ class Builder:
         return results[max(results)] if results else None
 
 
+    def _run_procs(self, seeds: List[int], test_fn) -> Any:
+        """Fork-based parallel sweep: ``procs`` OS processes, each running
+        an interleaved shard of the seed range sequentially.
+
+        The reference's sweep parallelism is real OS threads
+        (builder.rs:120-161 buffer_unordered); Python threads serialize on
+        the GIL for this CPU-bound work, so the multi-core path uses
+        processes instead. Per-seed isolation is total (each child builds
+        fresh Runtimes), so schedules are identical to the sequential
+        sweep. Fork start method: the test function is inherited, never
+        pickled; results cross back over a queue (unpicklable results
+        degrade to None; the sequential path is unaffected).
+        """
+        import multiprocessing as mp
+        import queue as _queue
+        import traceback as _tb
+
+        ctx = mp.get_context("fork")
+        q = ctx.Queue()
+
+        import io
+        import os as _os
+
+        def emit(buf: io.StringIO) -> None:
+            # one os.write per seed: atomic on a pipe (<= PIPE_BUF), so
+            # concurrent children's seed outputs never interleave mid-line
+            # (Python's print is two writes and garbles a shared fd)
+            data = buf.getvalue()
+            if data:
+                try:
+                    _os.write(sys.stdout.fileno(), data.encode())
+                except (OSError, ValueError):
+                    sys.stdout.write(data)
+                    sys.stdout.flush()
+
+        def child(shard: List[int]) -> None:
+            try:
+                for s in shard:
+                    buf = io.StringIO()
+                    prev_out = sys.stdout
+                    sys.stdout = buf  # group this seed's prints
+                    try:
+                        r = self._run_one(s, test_fn)
+                    except BaseException:  # noqa: BLE001 - reported to parent
+                        sys.stdout = prev_out
+                        emit(buf)
+                        q.put(("err", s, _tb.format_exc()))
+                        return
+                    sys.stdout = prev_out
+                    emit(buf)
+                    try:
+                        q.put(("ok", s, r))
+                    except Exception:  # unpicklable result
+                        q.put(("ok", s, None))
+            finally:
+                q.put(("done", shard[0], None))
+
+        n = min(self.procs, len(seeds))
+        shards = [seeds[i::n] for i in range(n)]
+        procs = [ctx.Process(target=child, args=(sh,), daemon=True) for sh in shards]
+        for p in procs:
+            p.start()
+        # drain WHILE children run — joining first deadlocks once queued
+        # results exceed the pipe capacity (children block in q.put); the
+        # sentinel counts children that finished, and a liveness check
+        # covers children killed without one (segfault/OOM)
+        results: dict = {}
+        failures: List[tuple] = []
+        done = 0
+        while done < n:
+            try:
+                kind, s, payload = q.get(timeout=0.5)
+            except _queue.Empty:
+                if not any(p.is_alive() for p in procs):
+                    break  # crashed child(s); nothing more is coming
+                continue
+            if kind == "ok":
+                results[s] = payload
+            elif kind == "err":
+                failures.append((s, payload))
+            else:
+                done += 1
+        for p in procs:
+            p.join()
+        reported = set(results) | {s for s, _ in failures}
+        for p, shard in zip(procs, shards):
+            if p.exitcode not in (0, None):
+                # attribute the death to the first seed the shard never
+                # reported — the one it was running when it died
+                unreported = [s for s in shard if s not in reported]
+                culprit = unreported[0] if unreported else shard[0]
+                failures.append(
+                    (culprit,
+                     f"worker running shard {shard} died with exit code "
+                     f"{p.exitcode} around seed {culprit} (no traceback "
+                     f"crossed the process boundary)")
+                )
+        if failures:
+            failures.sort(key=lambda f: f[0])
+            s, tb_text = failures[0]
+            _print_repro(s)
+            raise SimSweepError(
+                f"seed {s} failed in a sweep worker process:\n{tb_text}"
+            )
+        return results[max(results)] if results else None
+
+
+class SimSweepError(RuntimeError):
+    """A seed failed inside a process-sweep worker; carries the child's
+    formatted traceback (the original exception object lives in the child
+    — rerun with the printed MADSIM_TEST_SEED to debug it in-process)."""
+
+
 def _print_repro(seed: int) -> None:
     print(
         f"note: run with `MADSIM_TEST_SEED={seed}` environment variable "
@@ -159,6 +279,7 @@ def sim_test(
     seed: Optional[int] = None,
     count: Optional[int] = None,
     jobs: Optional[int] = None,
+    procs: Optional[int] = None,
     config: Optional[Config] = None,
     time_limit: Optional[float] = None,
     check_determinism: Optional[bool] = None,
@@ -179,6 +300,7 @@ def sim_test(
                 seed=env_seed if env_seed is not None else seed,
                 count=count,
                 jobs=jobs,
+                procs=procs,
                 config=config,
                 time_limit=time_limit,
                 check_determinism=check_determinism,

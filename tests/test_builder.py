@@ -99,3 +99,55 @@ send_latency = [0.002, 0.02]
         "[net]\npacket_loss_rate = 0.1\nsend_latency = [0.002, 0.02]\n"
     ).hash()
     assert cfg.hash() != Config().hash()
+
+
+def test_procs_sweep_matches_sequential():
+    """The fork-based process sweep must produce the same per-seed results
+    as the sequential sweep (total per-seed isolation, same schedules)."""
+    from madsim_tpu.builder import Builder
+
+    async def wl():
+        import madsim_tpu as ms
+
+        total = 0
+        for _ in range(5):
+            await ms.sleep(0.01)
+            total += ms.rand.gen_range(0, 100)
+        return total
+
+    seq = Builder(seed=100, count=6).run(wl)
+    par = Builder(seed=100, count=6, procs=3).run(wl)
+    assert seq == par
+
+
+def test_procs_sweep_failure_prints_repro_and_raises(capfd):
+    from madsim_tpu.builder import Builder, SimSweepError
+
+    async def boom():
+        import madsim_tpu as ms
+
+        await ms.sleep(0.01)
+        if ms.rand.gen_range(0, 3) == 1:
+            raise AssertionError("bad seed")
+
+    with pytest.raises(SimSweepError) as e:
+        Builder(seed=100, count=8, procs=2).run(boom)
+    assert "AssertionError" in str(e.value)
+    err = capfd.readouterr().err
+    assert "MADSIM_TEST_SEED=" in err
+
+
+def test_procs_sweep_large_result_volume_no_deadlock():
+    """The parent drains the result queue while children run — a sweep
+    whose queued results exceed the OS pipe capacity must not deadlock
+    (regression: join-before-drain hung once ~64KB of results queued)."""
+    from madsim_tpu.builder import Builder
+
+    async def wl():
+        import madsim_tpu as ms
+
+        await ms.sleep(0.001)
+        return "x" * 500  # ~500B/seed * 400 seeds >> pipe capacity
+
+    out = Builder(seed=0, count=400, procs=2).run(wl)
+    assert out == "x" * 500

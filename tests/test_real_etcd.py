@@ -12,6 +12,8 @@ async def _start_server(timeout_rate: float = 0.0):
     server = etcd.Server(etcd.EtcdService(), timeout_rate)
     task = real.spawn(server.serve(("127.0.0.1", 0)))
     while server.bound_addr is None:
+        if task.done():
+            task.result()  # surface the bind failure instead of spinning
         await real.sleep(0.005)
     host, port = server.bound_addr
     return server, task, f"{host}:{port}"

@@ -61,6 +61,8 @@ async def _start_greeter():
     router = grpc.Server.builder().add_service(Greeter())
     task = real.spawn(router.serve(("127.0.0.1", 0)))
     while router.bound_addr is None:
+        if task.done():
+            task.result()  # surface the bind failure instead of spinning
         await real.sleep(0.005)
     host, port = router.bound_addr
     return task, f"{host}:{port}"

@@ -16,6 +16,8 @@ async def _start_broker():
     broker = kafka.SimBroker()
     task = real.spawn(broker.serve(("127.0.0.1", 0)))
     while broker.bound_addr is None:
+        if task.done():
+            task.result()  # surface the bind failure instead of spinning
         await real.sleep(0.005)
     host, port = broker.bound_addr
     return broker, task, f"{host}:{port}"
@@ -83,6 +85,8 @@ async def _start_s3():
     server = s3.SimServer()
     task = real.spawn(server.serve(("127.0.0.1", 0)))
     while server.bound_addr is None:
+        if task.done():
+            task.result()  # surface the bind failure instead of spinning
         await real.sleep(0.005)
     host, port = server.bound_addr
     return server, task, f"{host}:{port}"
