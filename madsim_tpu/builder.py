@@ -166,6 +166,7 @@ class Builder:
         degrade to None; the sequential path is unaffected).
         """
         import multiprocessing as mp
+        import pickle as _pickle
         import queue as _queue
         import traceback as _tb
 
@@ -176,16 +177,22 @@ class Builder:
         import os as _os
 
         def emit(buf: io.StringIO) -> None:
-            # one os.write per seed: atomic on a pipe (<= PIPE_BUF), so
-            # concurrent children's seed outputs never interleave mid-line
-            # (Python's print is two writes and garbles a shared fd)
-            data = buf.getvalue()
-            if data:
-                try:
-                    _os.write(sys.stdout.fileno(), data.encode())
-                except (OSError, ValueError):
-                    sys.stdout.write(data)
-                    sys.stdout.flush()
+            # one os.write per seed: atomic on a pipe for payloads up to
+            # PIPE_BUF (4 KiB on Linux — larger seed outputs may interleave
+            # with other children, but are never LOST: the loop finishes
+            # partial writes), vs Python's two-write print which garbles a
+            # shared fd even for short lines
+            data = buf.getvalue().encode()
+            if not data:
+                return
+            try:
+                fd = sys.stdout.fileno()
+                while data:
+                    n = _os.write(fd, data)
+                    data = data[n:]
+            except (OSError, ValueError):
+                sys.stdout.write(buf.getvalue())
+                sys.stdout.flush()
 
         def child(shard: List[int]) -> None:
             try:
@@ -202,10 +209,15 @@ class Builder:
                         return
                     sys.stdout = prev_out
                     emit(buf)
+                    # probe picklability HERE: Queue.put pickles lazily in
+                    # a feeder thread, so a put-side try/except never
+                    # fires — the result would be silently dropped instead
+                    # of degrading to None
                     try:
-                        q.put(("ok", s, r))
-                    except Exception:  # unpicklable result
-                        q.put(("ok", s, None))
+                        _pickle.dumps(r)
+                    except Exception:
+                        r = None
+                    q.put(("ok", s, r))
             finally:
                 q.put(("done", shard[0], None))
 
@@ -232,23 +244,45 @@ class Builder:
                 results[s] = payload
             elif kind == "err":
                 failures.append((s, payload))
+                # fail fast like the jobs path (which stops scheduling on
+                # the first failure): the sweep is going to raise, so the
+                # other shards' remaining seeds are wasted work
+                for p in procs:
+                    if p.is_alive():
+                        p.terminate()
+                break
             else:
                 done += 1
         for p in procs:
             p.join()
-        reported = set(results) | {s for s, _ in failures}
-        for p, shard in zip(procs, shards):
-            if p.exitcode not in (0, None):
-                # attribute the death to the first seed the shard never
-                # reported — the one it was running when it died
-                unreported = [s for s in shard if s not in reported]
-                culprit = unreported[0] if unreported else shard[0]
-                failures.append(
-                    (culprit,
-                     f"worker running shard {shard} died with exit code "
-                     f"{p.exitcode} around seed {culprit} (no traceback "
-                     f"crossed the process boundary)")
-                )
+        # drain stragglers queued before the children stopped, so an
+        # also-failing LOWER seed still wins the repro print
+        while True:
+            try:
+                kind, s, payload = q.get_nowait()
+            except _queue.Empty:
+                break
+            if kind == "ok":
+                results[s] = payload
+            elif kind == "err":
+                failures.append((s, payload))
+        if not failures:
+            # a worker died without reporting (segfault/OOM): attribute
+            # the death to the first seed its shard never reported — the
+            # one it was running. (Skipped when a real failure exists:
+            # fail-fast terminate()s the others, and those exit codes are
+            # not failures.)
+            reported = set(results) | {s for s, _ in failures}
+            for p, shard in zip(procs, shards):
+                if p.exitcode not in (0, None):
+                    unreported = [s for s in shard if s not in reported]
+                    culprit = unreported[0] if unreported else shard[0]
+                    failures.append(
+                        (culprit,
+                         f"worker running shard {shard} died with exit code "
+                         f"{p.exitcode} around seed {culprit} (no traceback "
+                         f"crossed the process boundary)")
+                    )
         if failures:
             failures.sort(key=lambda f: f[0])
             s, tb_text = failures[0]

@@ -151,3 +151,21 @@ def test_procs_sweep_large_result_volume_no_deadlock():
 
     out = Builder(seed=0, count=400, procs=2).run(wl)
     assert out == "x" * 500
+
+
+def test_procs_sweep_unpicklable_result_degrades_to_none():
+    """A result that cannot cross the process boundary degrades to None
+    for that seed (probed eagerly — Queue.put pickles lazily in a feeder
+    thread, so a put-side try/except can't catch it)."""
+    from madsim_tpu.builder import Builder
+
+    async def wl():
+        import madsim_tpu as ms
+
+        await ms.sleep(0.001)
+        if ms.rand is not None:  # the LAST seed returns the lambda
+            pass
+        return (lambda: 1)  # unpicklable
+
+    out = Builder(seed=0, count=4, procs=2).run(wl)
+    assert out is None

@@ -353,3 +353,43 @@ def test_metrics():
         assert "main" in by_node
 
     rt.block_on(main())
+
+
+def test_forbid_creating_system_thread():
+    """OS threads inside a sim break determinism — blocked by default
+    (ref task/mod.rs forbid_creating_system_thread; pthread interposition
+    sim/task/mod.rs:761-785)."""
+    import threading
+
+    rt = ms.Runtime(seed=1)
+
+    async def main():
+        threading.Thread(target=lambda: None).start()
+
+    with pytest.raises(RuntimeError, match="OS thread"):
+        rt.block_on(main())
+
+
+def test_allow_creating_system_thread():
+    """set_allow_system_thread(True) opts back in (ref task/mod.rs
+    allow_creating_system_thread) — the thread really runs."""
+    import threading
+
+    rt = ms.Runtime(seed=1)
+    rt.set_allow_system_thread(True)
+    ran = []
+
+    async def main():
+        done = threading.Event()
+
+        def work():
+            ran.append(1)
+            done.set()
+
+        threading.Thread(target=work).start()
+        # wait on the REAL event (the sim clock doesn't drive OS threads);
+        # bounded so a regression can't hang the suite
+        assert done.wait(timeout=10.0)
+
+    rt.block_on(main())
+    assert ran == [1]
