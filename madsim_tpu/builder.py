@@ -164,6 +164,12 @@ class Builder:
         sweep. Fork start method: the test function is inherited, never
         pickled; results cross back over a queue (unpicklable results
         degrade to None; the sequential path is unaffected).
+
+        Constraint: procs-mode workloads must stay HOST-tier. Children are
+        forked from a possibly multithreaded parent (JAX spawns threads at
+        import), and JAX is never safe to use in a forked child — the
+        device-tier path for parallel seeds is ``engine.run_sweep``, which
+        batches seeds as array lanes instead of processes.
         """
         import multiprocessing as mp
         import pickle as _pickle
@@ -172,6 +178,9 @@ class Builder:
 
         ctx = mp.get_context("fork")
         q = ctx.Queue()
+        stop = ctx.Event()  # cooperative fail-fast (never terminate():
+        # killing a child mid-Queue.put corrupts the queue's pipe frame
+        # and hangs every later get())
 
         import io
         import os as _os
@@ -182,21 +191,36 @@ class Builder:
             # with other children, but are never LOST: the loop finishes
             # partial writes), vs Python's two-write print which garbles a
             # shared fd even for short lines
-            data = buf.getvalue().encode()
-            if not data:
-                return
+            data = memoryview(buf.getvalue().encode())
             try:
                 fd = sys.stdout.fileno()
-                while data:
-                    n = _os.write(fd, data)
-                    data = data[n:]
-            except (OSError, ValueError):
+            except (OSError, ValueError):  # captured stdout (pytest)
                 sys.stdout.write(buf.getvalue())
                 sys.stdout.flush()
+                return
+            while data:
+                try:
+                    n = _os.write(fd, data)
+                except OSError:
+                    # e.g. non-blocking fd: push only the REMAINING bytes
+                    # through the buffered layer (re-writing the whole
+                    # buffer would duplicate what already reached the fd)
+                    rest = bytes(data)
+                    stream = getattr(sys.stdout, "buffer", None)
+                    if stream is not None:
+                        stream.write(rest)
+                        stream.flush()
+                    else:
+                        sys.stdout.write(rest.decode(errors="replace"))
+                        sys.stdout.flush()
+                    return
+                data = data[n:]
 
         def child(shard: List[int]) -> None:
             try:
                 for s in shard:
+                    if stop.is_set():
+                        return  # another shard failed; stop between seeds
                     buf = io.StringIO()
                     prev_out = sys.stdout
                     sys.stdout = buf  # group this seed's prints
@@ -209,15 +233,16 @@ class Builder:
                         return
                     sys.stdout = prev_out
                     emit(buf)
-                    # probe picklability HERE: Queue.put pickles lazily in
-                    # a feeder thread, so a put-side try/except never
-                    # fires — the result would be silently dropped instead
-                    # of degrading to None
+                    # pickle HERE, once: Queue.put pickles lazily in a
+                    # feeder thread, so a put-side try/except never fires —
+                    # the result would be silently dropped instead of
+                    # degrading to None. Shipping the bytes avoids
+                    # double-serializing every result.
                     try:
-                        _pickle.dumps(r)
+                        blob = _pickle.dumps(r)
                     except Exception:
-                        r = None
-                    q.put(("ok", s, r))
+                        blob = None
+                    q.put(("ok", s, blob))
             finally:
                 q.put(("done", shard[0], None))
 
@@ -241,38 +266,23 @@ class Builder:
                     break  # crashed child(s); nothing more is coming
                 continue
             if kind == "ok":
-                results[s] = payload
+                results[s] = None if payload is None else _pickle.loads(payload)
             elif kind == "err":
                 failures.append((s, payload))
-                # fail fast like the jobs path (which stops scheduling on
-                # the first failure): the sweep is going to raise, so the
-                # other shards' remaining seeds are wasted work
-                for p in procs:
-                    if p.is_alive():
-                        p.terminate()
-                break
+                # fail fast like the jobs path: stop COOPERATIVELY (the
+                # other shards finish their in-flight seed, then exit —
+                # so an also-failing lower seed still reports and wins
+                # the repro print, and the queue stays intact)
+                stop.set()
             else:
                 done += 1
         for p in procs:
             p.join()
-        # drain stragglers queued before the children stopped, so an
-        # also-failing LOWER seed still wins the repro print
-        while True:
-            try:
-                kind, s, payload = q.get_nowait()
-            except _queue.Empty:
-                break
-            if kind == "ok":
-                results[s] = payload
-            elif kind == "err":
-                failures.append((s, payload))
         if not failures:
             # a worker died without reporting (segfault/OOM): attribute
             # the death to the first seed its shard never reported — the
-            # one it was running. (Skipped when a real failure exists:
-            # fail-fast terminate()s the others, and those exit codes are
-            # not failures.)
-            reported = set(results) | {s for s, _ in failures}
+            # one it was running
+            reported = set(results)
             for p, shard in zip(procs, shards):
                 if p.exitcode not in (0, None):
                     unreported = [s for s in shard if s not in reported]
@@ -305,6 +315,20 @@ def _print_repro(seed: int) -> None:
         f"to reproduce this failure",
         file=sys.stderr,
     )
+    if sys.flags.hash_randomization and os.environ.get("PYTHONHASHSEED") in (
+        None, "", "random",
+    ):
+        # the reference interposes HashMap seeding (sim/rand.rs:176-184);
+        # Python's str-hash salt is fixed at interpreter start and cannot
+        # be interposed, so iteration order of str-keyed sets/dicts-from-
+        # sets can differ in a NEW process. Tell the user how to pin it.
+        print(
+            "note: PYTHONHASHSEED is unset — if the failure does not "
+            "reproduce and the workload iterates str-keyed sets, also pin "
+            "`PYTHONHASHSEED=0` (Python's hash salt is per-process and "
+            "outside the simulator's control)",
+            file=sys.stderr,
+        )
 
 
 def sim_test(
