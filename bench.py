@@ -27,8 +27,14 @@ crash/restart fault injection, 3 virtual seconds per seed), with:
   order-of-magnitude estimate instead of a fake ratio.
 
 Timing methodology per docs/pallas_finding.md §0: fresh seed ranges per
-timed run (the tunneled device memoizes same-input executions) and a
-scalar host readback to bound completion.
+timed run (the tunneled device memoizes same-input executions), a scalar
+host readback to bound completion, and — because the tunneled chip drifts
+±30% across minutes and the host tier ±15% with machine load — every
+timed figure is the MIN of ``REPS`` interleaved repetitions (rep-outer,
+case-inner, exactly like scripts/bench_megakernel.py), with the
+max-over-min spread reported per point. The headline ``value`` is the
+chunked 131k sweep (the production pattern and the most drift-resistant
+number: ~3 s of device work per rep), not a single-shot curve point.
 """
 
 from __future__ import annotations
@@ -54,6 +60,9 @@ CURVE = (4096, 16384, 65536)
 # (see core.run_sweep_chunked), so chunking IS the fast path
 BIG_TOTAL = 131072
 BIG_CHUNK = 16384
+# min-of-REPS interleaved repetitions per timed figure (drift discipline;
+# see module docstring)
+REPS = 3
 
 _seed_cursor = [1]
 
@@ -64,42 +73,73 @@ def _fresh(n: int) -> jnp.ndarray:
     return jnp.arange(lo, lo + n, dtype=jnp.int64)
 
 
-def bench_host() -> float:
-    """Host-tier executor: one full simulation per seed (seeds/sec)."""
+def _spread(times) -> float:
+    """Max-over-min dispersion of a rep list: 0.0 = perfectly stable."""
+    return round((max(times) - min(times)) / min(times), 3) if times else 0.0
+
+
+def bench_host() -> dict:
+    """Host-tier executor: one full simulation per seed (seeds/sec),
+    min of REPS passes (the host number swings ±15% with machine load)."""
     sys.path.insert(0, __file__.rsplit("/", 1)[0] + "/examples")
     from raft_host import run_seed
 
-    t0 = walltime.perf_counter()
-    for seed in range(HOST_SEEDS):
-        run_seed(seed, n=5, crashes=1, sim_seconds=SIM_SECONDS)
-    return HOST_SEEDS / (walltime.perf_counter() - t0)
+    times = []
+    for rep in range(REPS):
+        t0 = walltime.perf_counter()
+        for seed in range(HOST_SEEDS):
+            run_seed(
+                rep * HOST_SEEDS + seed, n=5, crashes=1, sim_seconds=SIM_SECONDS
+            )
+        times.append(walltime.perf_counter() - t0)
+    return {
+        "seeds_per_sec": round(HOST_SEEDS / min(times), 2),
+        "reps": REPS,
+        "spread": _spread(times),
+    }
 
 
 def bench_curve(wl, ecfg, raft):
-    """seeds/sec at each batch size; compile time split out per size."""
+    """seeds/sec at each batch size: REPS interleaved timed runs per size
+    (rep-outer, size-inner, so a drift window hits every size equally),
+    min taken per size; compile time split out per size."""
     from madsim_tpu.engine import core
 
-    curve = []
+    compile_s = {}
+    summaries = {}
     for s in CURVE:
         t0 = walltime.perf_counter()
         warm = core.run_sweep(wl, ecfg, _fresh(s))
         int(warm.ctr.sum())
-        compile_s = walltime.perf_counter() - t0
-        t0 = walltime.perf_counter()
-        final = core.run_sweep(wl, ecfg, _fresh(s))
-        int(final.ctr.sum())
-        run_s = walltime.perf_counter() - t0
-        summary = raft.sweep_summary(final)
+        compile_s[s] = walltime.perf_counter() - t0
+    times = {s: [] for s in CURVE}
+    for _rep in range(REPS):
+        for s in CURVE:
+            t0 = walltime.perf_counter()
+            final = core.run_sweep(wl, ecfg, _fresh(s))
+            int(final.ctr.sum())
+            t = walltime.perf_counter() - t0
+            # keep the summary PAIRED with its own rep's time: each rep
+            # sweeps fresh seeds, so event totals differ slightly per rep
+            if not times[s] or t < min(times[s]):
+                summaries[s] = raft.sweep_summary(final)
+            times[s].append(t)
+    curve = []
+    for s in CURVE:
+        best = min(times[s])
+        summary = summaries[s]
         curve.append(
             {
                 "seeds": s,
-                "seeds_per_sec": round(s / run_s, 1),
-                "events_per_sec": round(summary["events_total"] / run_s, 1),
+                "seeds_per_sec": round(s / best, 1),
+                "events_per_sec": round(summary["events_total"] / best, 1),
                 "sim_sec_per_wall_sec": round(
-                    summary["sim_ns_total"] / run_s / 1e9, 1
+                    summary["sim_ns_total"] / best / 1e9, 1
                 ),
-                "compile_plus_first_run_s": round(compile_s, 2),
-                "run_s": round(run_s, 3),
+                "compile_plus_first_run_s": round(compile_s[s], 2),
+                "run_s": round(best, 3),
+                "reps": REPS,
+                "spread": _spread(times[s]),
                 "violations": summary["violations"],
             }
         )
@@ -110,23 +150,34 @@ def bench_100k(wl, ecfg, raft):
     """BASELINE config #5 scale: pod-scale sweep as 16k chunks of one
     compiled program, summaries merged on host per chunk — constant
     device memory, the pattern that extends to millions of seeds (each
-    chunk is also the checkpoint/restart granule)."""
+    chunk is also the checkpoint/restart granule). Min of REPS full
+    passes; this is the headline figure."""
     from madsim_tpu.engine import core
     from madsim_tpu.models._common import merge_summaries
 
-    t0 = walltime.perf_counter()
-    totals = {}
-    for _ in range(BIG_TOTAL // BIG_CHUNK):
-        final = core.run_sweep(wl, ecfg, _fresh(BIG_CHUNK))
-        merge_summaries(totals, raft.sweep_summary(final))
-    wall = walltime.perf_counter() - t0
+    times = []
+    best_totals = None
+    for _rep in range(REPS):
+        t0 = walltime.perf_counter()
+        totals = {}
+        for _ in range(BIG_TOTAL // BIG_CHUNK):
+            final = core.run_sweep(wl, ecfg, _fresh(BIG_CHUNK))
+            merge_summaries(totals, raft.sweep_summary(final))
+        wall = walltime.perf_counter() - t0
+        if not times or wall < min(times):
+            best_totals = totals
+        times.append(wall)
+        assert totals["violations"] == 0, totals
+    wall = min(times)
     return {
         "seeds": BIG_TOTAL,
         "chunk_size": BIG_CHUNK,
         "wall_s": round(wall, 2),
         "seeds_per_sec": round(BIG_TOTAL / wall, 1),
-        "events_per_sec": round(totals["events_total"] / wall, 1),
-        "violations": totals["violations"],
+        "events_per_sec": round(best_totals["events_total"] / wall, 1),
+        "reps": REPS,
+        "spread": _spread(times),
+        "violations": best_totals["violations"],
     }
 
 
@@ -224,15 +275,24 @@ def bench_etcd():
     wl = etcd.workload(cfg)
     warm = core.run_sweep(wl, ecfg, _fresh(8192))
     int(warm.ctr.sum())
-    t0 = walltime.perf_counter()
-    final = core.run_sweep(wl, ecfg, _fresh(8192))
-    int(final.ctr.sum())
-    run_s = walltime.perf_counter() - t0
-    s = etcd.sweep_summary(final)
+    times = []
+    best_final = None
+    for _rep in range(REPS):
+        t0 = walltime.perf_counter()
+        final = core.run_sweep(wl, ecfg, _fresh(8192))
+        int(final.ctr.sum())
+        t = walltime.perf_counter() - t0
+        if not times or t < min(times):
+            best_final = final
+        times.append(t)
+    run_s = min(times)
+    s = etcd.sweep_summary(best_final)
     return {
         "seeds": 8192,
         "seeds_per_sec": round(8192 / run_s, 1),
         "events_per_sec": round(s["events_total"] / run_s, 1),
+        "reps": REPS,
+        "spread": _spread(times),
         "violations": s["violations"],
         "partitions": s["partitions"],
         "lease_expiries": s["expiries"],
@@ -249,15 +309,24 @@ def bench_kafka():
     wl = kafka.workload(cfg)
     warm = core.run_sweep(wl, ecfg, _fresh(10240))
     int(warm.ctr.sum())
-    t0 = walltime.perf_counter()
-    final = core.run_sweep(wl, ecfg, _fresh(10240))
-    int(final.ctr.sum())
-    run_s = walltime.perf_counter() - t0
-    s = kafka.sweep_summary(final)
+    times = []
+    best_final = None
+    for _rep in range(REPS):
+        t0 = walltime.perf_counter()
+        final = core.run_sweep(wl, ecfg, _fresh(10240))
+        int(final.ctr.sum())
+        t = walltime.perf_counter() - t0
+        if not times or t < min(times):
+            best_final = final
+        times.append(t)
+    run_s = min(times)
+    s = kafka.sweep_summary(best_final)
     return {
         "seeds": 10240,
         "seeds_per_sec": round(10240 / run_s, 1),
         "events_per_sec": round(s["events_total"] / run_s, 1),
+        "reps": REPS,
+        "spread": _spread(times),
         "violations": s["violations"],
         "broker_crashes": s["crashes"],
         "records_consumed": s["fetched"],
@@ -274,7 +343,8 @@ def main() -> None:
 
     # host tier first: measured before device churn (GC/allocator
     # pressure from the TPU runs costs it ~2x)
-    host_rate = bench_host()
+    host = bench_host()
+    host_rate = host["seeds_per_sec"]
     curve = bench_curve(wl, ecfg, raft)
     big = bench_100k(wl, ecfg, raft)
     recovery = bench_recovery(wl, raft)
@@ -282,20 +352,32 @@ def main() -> None:
     kafka_line = bench_kafka()
     etcd_line = bench_etcd()
 
-    head = max(curve, key=lambda c: c["seeds_per_sec"])
+    # HEADLINE = the chunked 131k sweep: the production pattern, and —
+    # at ~3 s of device work per rep — the only number the tunneled
+    # chip's ±30% minute-scale drift cannot move (r03→r04: curve points
+    # swung −15..−35% with no code change while this one stayed flat,
+    # 44,192 → 44,214 seeds/s)
     print(
         json.dumps(
             {
                 "metric": "madraft_sweep_seeds_per_sec",
-                "value": head["seeds_per_sec"],
+                "value": big["seeds_per_sec"],
                 "unit": "seeds/s",
-                "vs_baseline": round(head["seeds_per_sec"] / host_rate, 1),
+                "vs_baseline": round(big["seeds_per_sec"] / host_rate, 1),
+                "headline_note": (
+                    f"chunked {BIG_TOTAL}-seed sweep ({BIG_CHUNK}-seed "
+                    f"chunks), min of {REPS} full passes; spread "
+                    f"{big['spread']}. Curve points below are min-of-"
+                    f"{REPS} interleaved reps with per-point spread."
+                ),
                 "baseline": {
                     "name": (
                         "host-tier single-thread executor, compiled C core "
-                        "(this repo, native/simloop.c)"
+                        "(this repo, native/simloop.c), min of "
+                        f"{REPS} passes"
                     ),
-                    "seeds_per_sec": round(host_rate, 2),
+                    "seeds_per_sec": host_rate,
+                    "spread": host["spread"],
                     "reference_note": (
                         "the Rust reference publishes no benchmark numbers "
                         "(BASELINE.md) and no Rust toolchain exists in this "
@@ -308,9 +390,7 @@ def main() -> None:
                         "vs_baseline as 'vs this repo's own host tier'"
                     ),
                 },
-                "headline_batch": head["seeds"],
-                "events_per_sec": head["events_per_sec"],
-                "sim_seconds_per_wall_sec": head["sim_sec_per_wall_sec"],
+                "events_per_sec": big["events_per_sec"],
                 "batch_curve": curve,
                 "sweep_100k": big,
                 "recovery_e2e": recovery,

@@ -38,6 +38,54 @@ def _env_flag(name: str) -> bool:
     return os.environ.get(name, "") not in ("", "0", "false", "False")
 
 
+class ProcsDeviceTierError(RuntimeError):
+    """A ``procs``-sweep child attempted to use JAX / the device tier.
+
+    Children are forked from a parent where JAX may already hold
+    threads and device handles; using JAX in a forked child hangs or
+    crashes rather than failing cleanly. Device-tier seed parallelism is
+    ``engine.run_sweep`` (seeds as array lanes), not OS processes.
+    """
+
+    def __init__(self, what: str = "jax"):
+        super().__init__(
+            f"device-tier workload under Builder(procs=N): {what} is not "
+            f"usable in a forked sweep child (JAX state does not survive "
+            f"fork). Use procs for HOST-tier workloads only; for parallel "
+            f"device seeds use madsim_tpu.engine.run_sweep, which batches "
+            f"seeds as array lanes on one process."
+        )
+
+
+def _poison_jax_in_child() -> None:
+    """Make any jax use inside a forked procs child raise the named error
+    instead of hanging: every already-imported ``jax*`` module is replaced
+    in sys.modules by a stub whose attribute access raises (a sys.modules
+    hit precedes the finders), and a meta-path finder refuses FRESH
+    ``import jax`` too — a child whose parent never imported jax would
+    otherwise initialize the real backend N times concurrently and hang
+    or segfault rather than raise."""
+    import importlib.abc
+    import types
+
+    class _Poisoned(types.ModuleType):
+        def __getattr__(self, name):  # noqa: D105
+            if name.startswith("__"):  # repr/spec introspection stays safe
+                raise AttributeError(name)
+            raise ProcsDeviceTierError(f"{self.__name__}.{name}")
+
+    for name in [n for n in sys.modules if n == "jax" or n.startswith("jax.")]:
+        sys.modules[name] = _Poisoned(name)
+
+    class _JaxImportBlocker(importlib.abc.MetaPathFinder):
+        def find_spec(self, fullname, path=None, target=None):
+            if fullname == "jax" or fullname.startswith("jax."):
+                raise ProcsDeviceTierError(f"import {fullname}")
+            return None
+
+    sys.meta_path.insert(0, _JaxImportBlocker())
+
+
 class Builder:
     """Configurable multi-seed test runner (ref ``Builder``, builder.rs)."""
 
@@ -217,6 +265,13 @@ class Builder:
                 data = data[n:]
 
         def child(shard: List[int]) -> None:
+            # structural fork-safety: device-tier use fails fast by name
+            # instead of hanging in inherited JAX state. The sentinel is
+            # pid-scoped so it only flags THIS forked process — an exec'd
+            # descendant (fresh interpreter, no inherited JAX state) may
+            # use the engine legitimately
+            os.environ["MADSIM_IN_PROCS_CHILD"] = str(os.getpid())
+            _poison_jax_in_child()
             try:
                 for s in shard:
                     if stop.is_set():

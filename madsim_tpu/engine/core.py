@@ -143,7 +143,24 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
 
 def init_sweep(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
     """Build the batched state for a seed vector (int64[S])."""
+    _procs_child_guard()
     return jax.vmap(partial(_init_one, workload, cfg))(jnp.asarray(seeds, jnp.int64))
+
+
+def _procs_child_guard() -> None:
+    """Fail by name, not by hang, when the device tier is entered from a
+    forked ``Builder(procs=N)`` sweep child (modules created before the
+    fork hold real jax references the child's sys.modules poison cannot
+    reach, so the engine checks the child's sentinel itself). The
+    sentinel carries the child's pid: an exec'd DESCENDANT of a child
+    (fresh interpreter, no inherited JAX state) inherits the env var but
+    not the pid, and may use the engine legitimately."""
+    import os
+
+    if os.environ.get("MADSIM_IN_PROCS_CHILD") == str(os.getpid()):
+        from ..builder import ProcsDeviceTierError
+
+        raise ProcsDeviceTierError("madsim_tpu.engine")
 
 
 def _pop_event(workload: Workload, s: EngineState, enable):
@@ -250,6 +267,7 @@ def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineSta
 def run_sweep(workload: Workload, cfg: EngineConfig, seeds) -> EngineState:
     """Run a whole seed batch to completion; returns the final batched
     state (workload stats live in ``.wstate``)."""
+    _procs_child_guard()
     return _run(workload, cfg, jnp.asarray(seeds, jnp.int64))
 
 

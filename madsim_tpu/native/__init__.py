@@ -26,17 +26,36 @@ _lib: Optional[ctypes.CDLL] = None
 _load_failed = False
 
 
-def _build() -> bool:
+def _compile_atomic(cmd_prefix: list, src: str, dst: str) -> bool:
+    """Compile to a pid-suffixed temp file, then os.rename into place.
+
+    Concurrent first-builders (forked procs-sweep children, parallel pytest
+    workers) would otherwise interleave compiler writes into the same .so
+    and leave a corrupt artifact behind; rename is atomic, so a concurrent
+    loader sees either the old or the complete new file.
+    """
+    tmp = f"{dst}.tmp.{os.getpid()}"
     try:
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _SO],
+            cmd_prefix + [src, "-o", tmp],
             check=True,
             capture_output=True,
             timeout=120,
         )
+        os.rename(tmp, dst)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
+
+
+def _build() -> bool:
+    return _compile_atomic(
+        ["g++", "-O2", "-shared", "-fPIC", "-std=c++17"], _SRC, _SO
+    )
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -184,21 +203,15 @@ _simloop_failed = False
 def _build_simloop() -> bool:
     import sysconfig
 
-    try:
-        subprocess.run(
-            [
-                # plain C: tentative type definitions + the CPython C API
-                "gcc", "-O2", "-shared", "-fPIC", "-std=c11",
-                "-I" + sysconfig.get_paths()["include"],
-                _SIMLOOP_SRC, "-o", _SIMLOOP_SO,
-            ],
-            check=True,
-            capture_output=True,
-            timeout=120,
-        )
-        return True
-    except Exception:
-        return False
+    return _compile_atomic(
+        [
+            # plain C: tentative type definitions + the CPython C API
+            "gcc", "-O2", "-shared", "-fPIC", "-std=c11",
+            "-I" + sysconfig.get_paths()["include"],
+        ],
+        _SIMLOOP_SRC,
+        _SIMLOOP_SO,
+    )
 
 
 def simloop():

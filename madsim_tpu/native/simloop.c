@@ -1021,7 +1021,13 @@ loop_rng_draw(LoopObj *self, uint64_t *out)
     return 0;
 }
 
-/* refresh the log/check gate; call at loop entry */
+/* refresh the log/check gate.  Called once per drain iteration and again
+ * before the per-poll advance draw, so enable_log()/enable_check() invoked
+ * from INSIDE a task mid-drain takes effect from the very next draw (the
+ * pure-Python next_u64 checks per draw; this keeps the native schedule's
+ * determinism log byte-identical in that edge case).  Flipping fast->slow
+ * hands the cached cursor back first so rng_next resumes at the right
+ * buffer position. */
 static int
 loop_rng_gate(LoopObj *self)
 {
@@ -1033,9 +1039,12 @@ loop_rng_gate(LoopObj *self)
         Py_DECREF(log);
         return -1;
     }
-    self->rng_fast = (log == Py_None && check == Py_None);
+    int fast = (log == Py_None && check == Py_None);
     Py_DECREF(log);
     Py_DECREF(check);
+    if (!fast && self->rng_fast && loop_rng_sync_out(self) < 0)
+        return -1;
+    self->rng_fast = fast;
     return 0;
 }
 
@@ -1066,10 +1075,11 @@ loop_run_all_ready(LoopObj *self, PyObject *Py_UNUSED(ignored))
     TimersObj *timers = self->timers;
     PyObject *tls = self->tls;
 
-    if (loop_rng_gate(self) < 0)
-        return NULL;
-
     for (;;) {
+        /* re-gate each iteration: the previous iteration may have run task
+         * code (poll, drop finally-blocks) that toggled log/check */
+        if (loop_rng_gate(self) < 0)
+            return NULL;
         Py_ssize_t n = PyList_GET_SIZE(items);
         if (n == 0)
             break;
@@ -1256,7 +1266,10 @@ loop_run_all_ready(LoopObj *self, PyObject *Py_UNUSED(ignored))
                 return NULL;
         }
 
-        /* random 50-100 ns advance per poll (ref task/mod.rs:312-315) */
+        /* random 50-100 ns advance per poll (ref task/mod.rs:312-315);
+         * the poll above ran task code, so re-gate before drawing */
+        if (loop_rng_gate(self) < 0)
+            return NULL;
         if (loop_rng_draw(self, &v) < 0)
             return NULL;
         timers->clock_ns += 50 + (int64_t)(((unsigned __int128)v * 51) >> 64);

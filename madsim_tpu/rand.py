@@ -53,6 +53,13 @@ class NondeterminismError(RuntimeError):
         )
 
 
+# One numpy refill per this many draws; the refill bound and the refill
+# size MUST stay equal or draws would silently repeat or skip the buffer
+# tail (the C fast path in simloop.c reads the same buffer via _buf_pos,
+# so the coupling crosses the language boundary).
+_BUF_SIZE = 1024
+
+
 class GlobalRng:
     """Seeded deterministic RNG + determinism log/check + buggify gate.
 
@@ -63,7 +70,7 @@ class GlobalRng:
     def __init__(self, seed: int):
         self.seed = int(seed) & _MASK64
         self._gen = np.random.Generator(np.random.Philox(key=self.seed))
-        # buffered draws: one numpy call per 1024 values — a per-draw
+        # buffered draws: one numpy call per _BUF_SIZE values — a per-draw
         # Generator.integers() call costs ~8 µs of numpy dispatch and was
         # ~25% of host-tier wall time; the batched stream is identical
         # for a given seed (the determinism contract is per-seed
@@ -111,11 +118,11 @@ class GlobalRng:
     def next_u64(self) -> int:
         pos = self._buf_pos
         buf = self._buf
-        if buf is None or pos >= 1024:
+        if buf is None or pos >= _BUF_SIZE:
             # .tolist() once per refill: indexing a Python list yields ints
             # directly, vs a numpy scalar + int() conversion per draw
             buf = self._buf = self._gen.integers(
-                0, 1 << 64, size=1024, dtype=np.uint64
+                0, 1 << 64, size=_BUF_SIZE, dtype=np.uint64
             ).tolist()
             pos = 0
         self._buf_pos = pos + 1

@@ -200,7 +200,9 @@ class StreamListener:
         host, port = parse_addr(addr)
 
         async def on_accept(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-            peer = writer.get_extra_info("peername")[:2]
+            # peername is None for a socket that disconnected before the
+            # callback ran; don't let a TypeError drop the connection
+            peer = (writer.get_extra_info("peername") or ("?", 0))[:2]
             tx, rx = _wrap(reader, writer)
             await self._pending.put((tx, rx, peer))
 

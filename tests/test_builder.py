@@ -153,6 +153,71 @@ def test_procs_sweep_large_result_volume_no_deadlock():
     assert out == "x" * 500
 
 
+def test_procs_sweep_device_tier_raises_named_error():
+    """A workload touching JAX (or the engine) under procs=N must fail
+    fast with ProcsDeviceTierError in the child — surfaced through the
+    sweep failure path — instead of hanging in inherited JAX state."""
+    import jax  # ensure jax is imported in the parent before the fork
+
+    from madsim_tpu.builder import Builder, SimSweepError
+
+    assert jax is not None
+
+    async def device_wl():
+        import jax.numpy as jnp  # resolves to the child's poisoned module
+
+        return jnp.zeros(4)
+
+    with pytest.raises(SimSweepError) as e:
+        Builder(seed=0, count=2, procs=2).run(device_wl)
+    assert "ProcsDeviceTierError" in str(e.value)
+
+    async def engine_wl():
+        from madsim_tpu.engine import core  # pre-fork module, real jax refs
+
+        from madsim_tpu.models import raft
+
+        cfg = raft.RaftConfig(num_nodes=3)
+        core.run_sweep(raft.workload(cfg), raft.engine_config(cfg), [0, 1])
+
+    with pytest.raises(SimSweepError) as e:
+        Builder(seed=0, count=2, procs=2).run(engine_wl)
+    assert "ProcsDeviceTierError" in str(e.value)
+
+
+def test_procs_sweep_fresh_jax_import_also_blocked():
+    """Even when the PARENT never imported jax, a child's fresh
+    ``import jax`` must raise the named error (meta-path finder), not
+    initialize the real backend N times concurrently."""
+    import subprocess
+    import sys
+
+    script = (
+        "import sys\n"
+        "sys.path.insert(0, '/root/repo')\n"
+        "from madsim_tpu.builder import Builder, SimSweepError\n"
+        "assert 'jax' not in sys.modules\n"
+        "async def wl():\n"
+        "    import jax\n"
+        "    return jax.numpy.zeros(2)\n"
+        "try:\n"
+        "    Builder(seed=0, count=2, procs=2).run(wl)\n"
+        "    print('NO-ERROR')\n"
+        "except SimSweepError as e:\n"
+        "    print('named' if 'ProcsDeviceTierError' in str(e) else 'other')\n"
+    )
+    env = {
+        k: v for k, v in dict(**__import__("os").environ).items()
+        if not k.startswith(("JAX_", "XLA_"))
+    }
+    r = subprocess.run(
+        [sys.executable, "-c", script], capture_output=True, text=True,
+        timeout=120, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert r.stdout.strip().endswith("named"), (r.stdout, r.stderr)
+
+
 def test_procs_sweep_unpicklable_result_degrades_to_none():
     """A result that cannot cross the process boundary degrades to None
     for that seed (probed eagerly — Queue.put pickles lazily in a feeder
