@@ -41,9 +41,11 @@ from typing import Any, Callable, Dict, NamedTuple, Optional
 # `service` attribute to the decorator function, so `from . import
 # service` would grab that instead of the module
 from .service import (
+    _IO_ATTR,
     _KIND_ATTR,
     _NAME_ATTR,
     _TABLE_ATTR,
+    _WIRE_ATTR,
     ServiceClient,
     service as _service_decorator,
 )
@@ -63,6 +65,12 @@ _COMPILED_SHA: Dict[str, str] = {}
 class ServiceSpec(NamedTuple):
     full_name: str
     methods: Dict[str, str]  # python snake_case name -> call kind
+    #: snake_case name -> (request type full name, response type full name);
+    #: resolved to message classes on demand (grpcio interop needs them)
+    io: Dict[str, tuple] = {}
+    #: snake_case name -> literal proto method name (wire-path segment for
+    #: stock-gRPC peers; camel() does not round-trip acronym names)
+    wire: Dict[str, str] = {}
 
 
 def _snake(name: str) -> str:
@@ -108,7 +116,10 @@ class ProtoPackage:
                         f"declared by {full_name} in the proto"
                     )
                 setattr(fn, _KIND_ATTR, kind)
-            return _service_decorator(full_name)(cls)
+            cls = _service_decorator(full_name)(cls)
+            setattr(cls, _IO_ATTR, self._io_classes(spec))
+            setattr(cls, _WIRE_ATTR, dict(spec.wire))
+            return cls
 
         return deco
 
@@ -118,16 +129,36 @@ class ProtoPackage:
                interceptor: Optional[Callable] = None) -> ServiceClient:
         """Typed client built from the proto alone — no server class
         needed in-process (the generated-client analogue)."""
+        return ServiceClient(self.stub(full_name), channel, interceptor)
+
+    def stub(self, full_name: str) -> type:
+        """A class carrying the service's name, method table, and message
+        types — what ``ServiceClient`` (sim or grpcio-backed) needs to
+        derive a typed client without a server class in-process."""
         spec = self._spec(full_name)
-        stub = type(
+        return type(
             spec.full_name.rsplit(".", 1)[-1] + "Stub",
             (),
             {
                 _NAME_ATTR: spec.full_name,
                 _TABLE_ATTR: dict(spec.methods),
+                _IO_ATTR: self._io_classes(spec),
+                _WIRE_ATTR: dict(spec.wire),
             },
         )
-        return ServiceClient(stub, channel, interceptor)
+
+    def _io_classes(self, spec: ServiceSpec) -> Dict[str, tuple]:
+        """snake method name -> (request class, response class). Methods
+        whose types didn't resolve (e.g. nested message types) are
+        omitted — the sim transport doesn't need them; the grpcio interop
+        layer reports the gap by name if such a method is ever called."""
+        out: Dict[str, tuple] = {}
+        for snake, (req_name, rsp_name) in spec.io.items():
+            req = self.messages.get(req_name)
+            rsp = self.messages.get(rsp_name)
+            if req is not None and rsp is not None:
+                out[snake] = (req, rsp)
+        return out
 
     def _spec(self, full_name: str) -> ServiceSpec:
         spec = self.services.get(full_name)
@@ -250,6 +281,15 @@ def compile_protos(*protos: str, includes: tuple = ()) -> ProtoPackage:
                 services[full] = ServiceSpec(
                     full_name=full,
                     methods={_snake(m.name): _kind(m) for m in svc.method},
+                    # descriptor type refs are ".pkg.Msg"-qualified
+                    io={
+                        _snake(m.name): (
+                            m.input_type.lstrip("."),
+                            m.output_type.lstrip("."),
+                        )
+                        for m in svc.method
+                    },
+                    wire={_snake(m.name): m.name for m in svc.method},
                 )
 
         return ProtoPackage(services, messages, modules)

@@ -34,6 +34,15 @@ from .status import Status
 _KIND_ATTR = "__grpc_kind__"
 _NAME_ATTR = "__grpc_service_name__"
 _TABLE_ATTR = "__grpc_methods__"
+# protogen-attached: snake method name -> (request message class, response
+# message class). Only proto-derived services carry it; the grpcio interop
+# layer (real/grpc.py) needs it for wire serialization.
+_IO_ATTR = "__grpc_io__"
+# protogen-attached: snake method name -> the LITERAL proto method name.
+# camel() does not round-trip acronym names (GetTPUInfo -> get_tpu_info ->
+# GetTpuInfo), and a stock gRPC peer uses the descriptor's literal name in
+# the wire path, so the grpcio tier must too.
+_WIRE_ATTR = "__grpc_wire_names__"
 
 
 def camel(snake: str) -> str:
