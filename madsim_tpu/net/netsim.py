@@ -42,6 +42,11 @@ class NetSim(Simulator):
         self._rpc_req_hooks: List[Hook] = []
         self._rpc_rsp_hooks: List[Hook] = []
         self._node_pipes: Dict[NodeId, List["_Pipe"]] = {}
+        # per-node Unix-domain namespaces (net/unix.py): (node, path) ->
+        # listener accept queue / datagram socket. Paths are node-local
+        # like the per-node fs, so entries die with the node.
+        self.unix_listeners: Dict[Tuple[NodeId, str], Any] = {}
+        self.unix_dgrams: Dict[Tuple[NodeId, str], Any] = {}
 
     # -- plugin lifecycle --------------------------------------------------
 
@@ -57,6 +62,11 @@ class NetSim(Simulator):
         self._node_pipes[id] = []
         for pipe in pipes:
             pipe.break_pipe()
+        # unix namespaces are node-local state: drop them with the node
+        for key in [k for k in self.unix_listeners if k[0] == id]:
+            self.unix_listeners.pop(key).break_all()
+        for key in [k for k in self.unix_dgrams if k[0] == id]:
+            self.unix_dgrams.pop(key)._broken = True
 
     # -- config / topology -------------------------------------------------
 

@@ -18,7 +18,9 @@ reference std transport's shape), the built-in RPC (``call`` /
 ``add_rpc_handler``) on either, and real-mode twins of ALL FOUR ecosystem
 shims — ``real.grpc`` (the same @service classes over framed TCP),
 ``real.etcd``, ``real.kafka``, ``real.s3`` (the unchanged client APIs
-against the framework's own state machines on real sockets). Frames use
+against the framework's own state machines on real sockets) — plus
+``real.fs`` (the sim fs API over actual files, the std/fs.rs analogue)
+and ``real.signal`` (``ctrl_c`` over a real SIGINT). Frames use
 the restricted binary codec (real/codec.py) — never pickle, so a hostile
 peer cannot execute code.
 Randomness is real randomness; there is no determinism in real mode
@@ -33,17 +35,21 @@ from . import codec
 from . import stream
 from . import grpc
 from . import etcd
+from . import fs
 from . import kafka
 from . import s3
+from . import signal
 
 __all__ = [
     "Endpoint",
     "TcpEndpoint",
     "codec",
     "etcd",
+    "fs",
     "grpc",
     "kafka",
     "s3",
+    "signal",
     "stream",
     "Instant",
     "Runtime",

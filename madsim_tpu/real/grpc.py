@@ -213,17 +213,19 @@ class GrpcioStreaming:
         self._call = call
         self._it = call.__aiter__()
         self._done = False
+        # captured once: resolving the module per streamed message would
+        # put an import-machinery lookup on the hot read path
+        self._rpc_error = _grpc_mod().aio.AioRpcError
 
     async def message(self) -> Optional[Any]:
         if self._done:
             return None
-        grpcio = _grpc_mod()
         try:
             return await self._it.__anext__()
         except StopAsyncIteration:
             self._done = True
             return None
-        except grpcio.aio.AioRpcError as e:
+        except self._rpc_error as e:
             self._done = True
             raise _to_status(e) from None
 
@@ -271,14 +273,15 @@ class GrpcioChannel:
         await self._ch.close()
 
 
-class GrpcioGrpc:
-    """The generic caller over real gRPC wire — same four call shapes and
-    interceptor/timeout semantics as the sim ``client.Grpc``."""
+class GrpcioGrpc(Grpc):
+    """The generic caller over real gRPC wire — the four call shapes are
+    reimplemented on grpcio multicallables; ``_prepare`` (interceptor then
+    default-timeout injection) is INHERITED from the one implementation in
+    grpc/client.py so the three tiers cannot drift."""
 
     def __init__(self, channel: GrpcioChannel, interceptor=None,
                  service_cls: Optional[type] = None):
-        self.channel = channel
-        self.interceptor = interceptor
+        super().__init__(channel, interceptor)
         self._io = _io_table(service_cls) if service_cls is not None else {}
         # literal proto method name -> snake (acronym-safe path resolution)
         wire = getattr(service_cls, _WIRE_ATTR, {}) if service_cls else {}
@@ -292,13 +295,6 @@ class GrpcioGrpc:
         g._io = self._io
         g._wire_to_snake = self._wire_to_snake
         return g
-
-    def _prepare(self, request: Request) -> Request:
-        if self.interceptor is not None:
-            request = self.interceptor(request)
-        if request.timeout() is None and self.channel.default_timeout is not None:
-            request.set_timeout(self.channel.default_timeout)
-        return request
 
     def _multicallable(self, shape: str, path: str):
         """The cached grpcio multicallable for one method path."""

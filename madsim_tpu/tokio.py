@@ -32,6 +32,199 @@ from .task import spawn, spawn_local
 from .time import interval, sleep, sleep_until, timeout
 
 
+class io:
+    """``tokio::io`` analogue — REAL asyncio streams.
+
+    The reference's madsim-tokio keeps real tokio ``io`` available even in
+    sim mode (madsim-tokio/src/lib.rs:38-50); this namespace is the same
+    stance: asyncio's stream machinery re-exported plus a ``copy`` helper.
+    Under the simulator there is no asyncio loop, so any await here fails
+    loudly ("no running event loop") instead of leaking nondeterminism —
+    use the sim ``net``/``fs`` surfaces inside simulations.
+    """
+
+    import asyncio as _aio
+
+    StreamReader = _aio.StreamReader
+    StreamWriter = _aio.StreamWriter
+    open_connection = staticmethod(_aio.open_connection)
+    start_server = staticmethod(_aio.start_server)
+
+    @staticmethod
+    async def copy(reader: "io.StreamReader", writer: "io.StreamWriter",
+                   chunk_size: int = 64 * 1024) -> int:
+        """``tokio::io::copy``: pump reader to writer until EOF; returns
+        bytes copied."""
+        total = 0
+        while True:
+            chunk = await reader.read(chunk_size)
+            if not chunk:
+                break
+            writer.write(chunk)
+            await writer.drain()
+            total += len(chunk)
+        return total
+
+    @staticmethod
+    async def duplex(_max_buf_size: int = 64 * 1024):
+        """``tokio::io::duplex``: an in-memory bidirectional pipe as two
+        (reader, writer) ends."""
+        import asyncio
+
+        a_to_b: asyncio.Queue = asyncio.Queue()
+        b_to_a: asyncio.Queue = asyncio.Queue()
+
+        class _End:
+            def __init__(self, inbox, outbox):
+                self._inbox, self._outbox = inbox, outbox
+                self._buf = b""
+                self._eof = False
+
+            async def read(self, n: int = -1) -> bytes:
+                if not self._buf and not self._eof:
+                    chunk = await self._inbox.get()
+                    if chunk is None:
+                        self._eof = True
+                    else:
+                        self._buf += chunk
+                if n < 0:
+                    out, self._buf = self._buf, b""
+                else:
+                    out, self._buf = self._buf[:n], self._buf[n:]
+                return out
+
+            def write(self, data: bytes) -> None:
+                self._outbox.put_nowait(bytes(data))
+
+            async def drain(self) -> None:
+                pass
+
+            def close(self) -> None:
+                self._outbox.put_nowait(None)
+
+        return _End(b_to_a, a_to_b), _End(a_to_b, b_to_a)
+
+
+class process:
+    """``tokio::process`` analogue — REAL subprocesses over asyncio.
+
+    Mirrors ``tokio::process::Command``'s builder shape on top of
+    ``asyncio.create_subprocess_exec``. Like ``tokio.io``, this is real
+    I/O kept available alongside the sim (madsim-tokio/src/lib.rs:38-50);
+    inside the simulator the missing asyncio loop fails any await loudly.
+    """
+
+    import asyncio as _aio
+
+    PIPE = _aio.subprocess.PIPE
+    STDOUT = _aio.subprocess.STDOUT
+    DEVNULL = _aio.subprocess.DEVNULL
+
+    class ExitStatus:
+        def __init__(self, code: Optional[int]):
+            self._code = code
+
+        def success(self) -> bool:
+            return self._code == 0
+
+        def code(self) -> Optional[int]:
+            return self._code
+
+        def __repr__(self) -> str:
+            return f"ExitStatus({self._code})"
+
+    class Output:
+        def __init__(self, status: "process.ExitStatus", stdout: bytes,
+                     stderr: bytes):
+            self.status = status
+            self.stdout = stdout
+            self.stderr = stderr
+
+    class Command:
+        """``tokio::process::Command``: program + args/env/cwd builder,
+        then ``spawn()`` / ``output()`` / ``status()``."""
+
+        def __init__(self, program: str):
+            self._program = str(program)
+            self._args: List[str] = []
+            self._env: Optional[dict] = None
+            self._cwd: Optional[str] = None
+            self._stdin = None
+            self._stdout = None
+            self._stderr = None
+
+        def arg(self, a: Any) -> "process.Command":
+            self._args.append(str(a))
+            return self
+
+        def args(self, it: Any) -> "process.Command":
+            self._args.extend(str(a) for a in it)
+            return self
+
+        def env(self, key: str, val: str) -> "process.Command":
+            if self._env is None:
+                import os
+
+                self._env = dict(os.environ)
+            self._env[str(key)] = str(val)
+            return self
+
+        def env_clear(self) -> "process.Command":
+            self._env = {}
+            return self
+
+        def current_dir(self, d: str) -> "process.Command":
+            self._cwd = str(d)
+            return self
+
+        def stdin(self, v: Any) -> "process.Command":
+            self._stdin = v
+            return self
+
+        def stdout(self, v: Any) -> "process.Command":
+            self._stdout = v
+            return self
+
+        def stderr(self, v: Any) -> "process.Command":
+            self._stderr = v
+            return self
+
+        async def spawn(self):
+            """Start the child; returns the asyncio subprocess (``Child``
+            analogue: .stdin/.stdout/.stderr/.wait()/.kill())."""
+            import asyncio
+
+            return await asyncio.create_subprocess_exec(
+                self._program,
+                *self._args,
+                env=self._env,
+                cwd=self._cwd,
+                stdin=self._stdin,
+                stdout=self._stdout,
+                stderr=self._stderr,
+            )
+
+        async def output(self) -> "process.Output":
+            """Run to completion capturing stdout/stderr."""
+            import asyncio
+
+            child = await asyncio.create_subprocess_exec(
+                self._program,
+                *self._args,
+                env=self._env,
+                cwd=self._cwd,
+                stdin=self._stdin,
+                stdout=asyncio.subprocess.PIPE,
+                stderr=asyncio.subprocess.PIPE,
+            )
+            out, err = await child.communicate()
+            return process.Output(process.ExitStatus(child.returncode), out, err)
+
+        async def status(self) -> "process.ExitStatus":
+            child = await self.spawn()
+            return process.ExitStatus(await child.wait())
+
+
 class runtime:
     """Namespace mirroring ``tokio::runtime``."""
 
@@ -123,8 +316,10 @@ __all__ = [
     "JoinHandle",
     "fs",
     "interval",
+    "io",
     "join",
     "net",
+    "process",
     "runtime",
     "select",
     "signal",

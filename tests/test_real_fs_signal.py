@@ -1,0 +1,175 @@
+"""Real-mode fs + signal twins and the tokio io/process surfaces: the sim
+API shapes over actual files, OS signals, asyncio streams, and real
+subprocesses (ref madsim/src/std/fs.rs; madsim-tokio/src/lib.rs:38-50
+keeps real fs/io/process alongside the sim)."""
+
+import os
+import signal as os_signal
+
+import pytest
+
+from madsim_tpu import real, tokio
+
+
+def test_real_fs_file_surface(tmp_path):
+    """The sim File surface (positional I/O, set_len, sync_all, metadata)
+    over a real file."""
+    path = str(tmp_path / "data.bin")
+
+    async def main():
+        f = await real.fs.File.create(path)
+        await f.write_all(b"hello ")
+        await f.write_all(b"world")          # append semantics
+        await f.write_all_at(b"HELLO", 0)    # positional overwrite
+        await f.sync_all()
+        assert await f.read_at(5, 6) == b"world"
+        assert await f.read_all() == b"HELLO world"
+        meta = await f.metadata()
+        assert meta.len() == 11 and meta.is_file()
+        await f.set_len(5)
+        assert await f.read_all() == b"HELLO"
+        await f.set_len(8)                   # extend zero-fills
+        assert await f.read_all() == b"HELLO\x00\x00\x00"
+        f.close()
+        with pytest.raises(ValueError):
+            await f.read_all()
+
+        # open_or_create keeps existing contents; open on missing raises
+        f2 = await real.fs.File.open_or_create(path)
+        assert (await f2.read_all()).startswith(b"HELLO")
+        f2.close()
+        with pytest.raises(FileNotFoundError):
+            await real.fs.File.open(str(tmp_path / "missing"))
+
+    real.Runtime().block_on(main())
+
+
+def test_real_fs_module_helpers(tmp_path):
+    path = str(tmp_path / "blob")
+
+    async def main():
+        await real.fs.write(path, b"abc123")
+        assert await real.fs.read(path) == b"abc123"
+        assert (await real.fs.metadata(path)).len() == 6
+        await real.fs.remove_file(path, durable=True)
+        assert not os.path.exists(path)
+        with pytest.raises(FileNotFoundError):
+            await real.fs.read(path)
+
+    real.Runtime().block_on(main())
+
+
+def test_real_signal_ctrl_c_waits_for_sigint():
+    """ctrl_c resolves on a real SIGINT and restores the previous handler
+    afterwards (no KeyboardInterrupt leaks into the test process)."""
+
+    async def main():
+        async def fire():
+            await real.sleep(0.05)
+            os.kill(os.getpid(), os_signal.SIGINT)
+
+        task = real.spawn(fire())
+        await real.timeout(5.0, real.signal.ctrl_c())
+        await task
+
+    real.Runtime().block_on(main())
+    # handler restored: a default-action probe would now raise in Python's
+    # default handler, so just check the asyncio handler is gone
+    assert os_signal.getsignal(os_signal.SIGINT) is os_signal.default_int_handler
+
+
+def test_real_signal_wakes_all_concurrent_waiters():
+    """Multiple tasks awaiting ctrl_c all resolve on ONE signal — the sim
+    twin wakes every waiter (signal.py ctrl_c_waiters), so real mode must
+    too; a per-waiter handler would strand all but the last."""
+
+    async def main():
+        woke = []
+
+        async def waiter(tag):
+            await real.signal.ctrl_c()
+            woke.append(tag)
+
+        t1 = real.spawn(waiter("a"))
+        t2 = real.spawn(waiter("b"))
+        t3 = real.spawn(waiter("c"))
+        await real.sleep(0.05)
+        os.kill(os.getpid(), os_signal.SIGINT)
+        await real.timeout(5.0, t1)
+        await real.timeout(5.0, t2)
+        await real.timeout(5.0, t3)
+        assert sorted(woke) == ["a", "b", "c"]
+
+    real.Runtime().block_on(main())
+    assert os_signal.getsignal(os_signal.SIGINT) is os_signal.default_int_handler
+
+
+def test_tokio_process_command_surface():
+    """tokio::process::Command analogue over real subprocesses."""
+
+    async def main():
+        out = await tokio.process.Command("echo").arg("hi").output()
+        assert out.status.success() and out.status.code() == 0
+        assert out.stdout == b"hi\n" and out.stderr == b""
+
+        st = await tokio.process.Command("sh").args(["-c", "exit 3"]).status()
+        assert not st.success() and st.code() == 3
+
+        # env + cwd builders
+        out = await (
+            tokio.process.Command("sh")
+            .args(["-c", "echo $MADSIM_T:$PWD"])
+            .env("MADSIM_T", "v")
+            .current_dir("/tmp")
+            .output()
+        )
+        assert out.stdout == b"v:/tmp\n"
+
+        # spawn gives the Child analogue
+        child = await tokio.process.Command("sleep").arg("10").spawn()
+        child.kill()
+        assert await child.wait() != 0
+
+    real.Runtime().block_on(main())
+
+
+def test_tokio_io_streams_and_copy():
+    """tokio::io analogue: real asyncio server/connection plus copy()."""
+
+    async def main():
+        async def echo(reader, writer):
+            await tokio.io.copy(reader, writer)
+            writer.close()
+
+        server = await tokio.io.start_server(echo, "127.0.0.1", 0)
+        port = server.sockets[0].getsockname()[1]
+        reader, writer = await tokio.io.open_connection("127.0.0.1", port)
+        writer.write(b"ping" * 1000)
+        writer.write_eof()
+        await writer.drain()
+        assert await reader.read(-1) == b"ping" * 1000
+        writer.close()
+        server.close()
+
+        # in-memory duplex pipe
+        a, b = await tokio.io.duplex()
+        a.write(b"x1")
+        b.write(b"y2")
+        assert await b.read(2) == b"x1"
+        assert await a.read(2) == b"y2"
+        a.close()
+        assert await b.read(1) == b""
+
+    real.Runtime().block_on(main())
+
+
+def test_tokio_io_fails_loudly_inside_the_sim():
+    """Inside the simulator there is no asyncio loop: real-IO surfaces
+    raise instead of silently breaking determinism."""
+    import madsim_tpu as ms
+
+    async def wl():
+        with pytest.raises(RuntimeError):
+            await tokio.process.Command("echo").arg("x").output()
+
+    ms.Runtime(seed=1).block_on(wl())

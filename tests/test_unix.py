@@ -1,0 +1,239 @@
+"""Unix domain socket tests — shaped like the TCP battery in
+tests/test_net.py. The reference's unix sockets are all ``todo!()``
+(madsim/src/sim/net/unix/); this suite covers the implemented simulation:
+node-local path namespaces, stream echo/EOF/refused, datagram delivery,
+bind conflicts, kill cleanup, and schedule determinism."""
+
+import pytest
+
+import madsim_tpu as ms
+from madsim_tpu.net import UnixDatagram, UnixListener, UnixStream
+
+
+def test_unix_stream_echo():
+    rt = ms.Runtime(seed=21)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def server():
+            listener = await UnixListener.bind("/tmp/echo.sock")
+            stream, peer = await listener.accept()
+            assert peer == ""  # anonymous client, like the OS
+            data = await stream.read_exact(5)
+            await stream.write_all_flush(b"echo:" + data)
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await UnixStream.connect("/tmp/echo.sock")
+            assert stream.peer_addr() == "/tmp/echo.sock"
+            await stream.write_all_flush(b"hello")
+            return await stream.read_exact(10)
+
+        n1.spawn(server())
+        assert await n1.spawn(client()) == b"echo:hello"
+
+    rt.block_on(main())
+
+
+def test_unix_stream_eof_on_shutdown():
+    rt = ms.Runtime(seed=22)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def server():
+            listener = await UnixListener.bind("/run/x.sock")
+            stream, _ = await listener.accept()
+            await stream.write_all_flush(b"bye")
+            stream.shutdown()
+
+        async def client():
+            await ms.sleep(0.1)
+            stream = await UnixStream.connect("/run/x.sock")
+            assert await stream.read_exact(3) == b"bye"
+            assert await stream.read(10) == b""  # EOF
+
+        n1.spawn(server())
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_unix_connect_refused_without_listener():
+    rt = ms.Runtime(seed=23)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def client():
+            with pytest.raises(ConnectionRefusedError):
+                await UnixStream.connect("/no/such.sock")
+
+        await n1.spawn(client())
+
+    rt.block_on(main())
+
+
+def test_unix_paths_are_node_local():
+    """Two nodes bind the SAME path without conflict, and a connect on one
+    node never reaches the other node's listener."""
+    rt = ms.Runtime(seed=24)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+        n2 = h.create_node().name("n2").build()
+
+        async def serve(reply: bytes):
+            listener = await UnixListener.bind("/svc.sock")
+            stream, _ = await listener.accept()
+            await stream.write_all_flush(reply)
+
+        async def ask():
+            await ms.sleep(0.1)
+            stream = await UnixStream.connect("/svc.sock")
+            return await stream.read_exact(2)
+
+        n1.spawn(serve(b"N1"))
+        n2.spawn(serve(b"N2"))
+        r1 = n1.spawn(ask())
+        r2 = n2.spawn(ask())
+        assert await r1 == b"N1"
+        assert await r2 == b"N2"
+
+    rt.block_on(main())
+
+
+def test_unix_bind_conflict_and_close_frees_path():
+    rt = ms.Runtime(seed=25)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def wl():
+            listener = await UnixListener.bind("/one.sock")
+            with pytest.raises(OSError, match="already in use"):
+                await UnixListener.bind("/one.sock")
+            with pytest.raises(OSError, match="already in use"):
+                await UnixDatagram.bind("/one.sock")  # shared namespace
+            listener.close()
+            listener2 = await UnixListener.bind("/one.sock")  # freed
+            listener2.close()
+
+        await n1.spawn(wl())
+
+    rt.block_on(main())
+
+
+def test_unix_kill_clears_namespace_and_breaks_streams():
+    """Node kill drops the node's unix bindings (restart can rebind) and
+    breaks its live pipes, like TCP."""
+    rt = ms.Runtime(seed=26)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def bind_and_hold():
+            await UnixListener.bind("/held.sock")
+            await ms.sleep(100)
+
+        n1.spawn(bind_and_hold())
+        await ms.sleep(0.5)
+        h.kill(n1.id)
+        h.restart(n1.id)
+
+        async def rebind():
+            listener = await UnixListener.bind("/held.sock")  # no conflict
+            listener.close()
+
+        await n1.spawn(rebind())
+
+    rt.block_on(main())
+
+
+def test_unix_datagram_send_recv():
+    rt = ms.Runtime(seed=27)
+
+    async def main():
+        h = ms.current_handle()
+        n1 = h.create_node().name("n1").build()
+
+        async def wl():
+            a = await UnixDatagram.bind("/a.sock")
+            b = await UnixDatagram.bind("/b.sock")
+            assert a.local_addr() == "/a.sock"
+
+            assert await a.send_to(b"ping", "/b.sock") == 4
+            data, src = await b.recv_from()
+            assert (data, src) == (b"ping", "/a.sock")
+
+            # connected mode
+            b.connect("/a.sock")
+            await b.send(b"pong")
+            assert await a.recv() == b"pong"
+
+            # unbound sender: can send, shows empty source
+            ub = UnixDatagram.unbound()
+            await ub.send_to(b"anon", "/a.sock")
+            data, src = await a.recv_from()
+            assert (data, src) == (b"anon", "")
+
+            # missing destination errors (kernel semantics, unlike UDP)
+            with pytest.raises(ConnectionRefusedError):
+                await a.send_to(b"x", "/missing.sock")
+            # unconnected send errors
+            with pytest.raises(OSError, match="not connected"):
+                await a.send(b"x")
+            a.close()
+            b.close()
+
+        await n1.spawn(wl())
+
+    rt.block_on(main())
+
+
+def test_unix_deterministic_across_runs():
+    """Same seed => identical interleaving of unix traffic."""
+
+    def run(seed: int):
+        rt = ms.Runtime(seed=seed)
+        log = []
+
+        async def main():
+            h = ms.current_handle()
+            n1 = h.create_node().name("n1").build()
+
+            async def server():
+                listener = await UnixListener.bind("/d.sock")
+                for _ in range(3):
+                    stream, _ = await listener.accept()
+                    data = await stream.read_exact(2)
+                    log.append(("srv", data, ms.current_handle().time.now_ns))
+                    await stream.write_all_flush(data.upper())
+
+            async def client(tag: bytes):
+                await ms.sleep(0.01)
+                stream = await UnixStream.connect("/d.sock")
+                await stream.write_all_flush(tag)
+                log.append((tag, await stream.read_exact(2)))
+
+            n1.spawn(server())
+            await ms.join(
+                n1.spawn(client(b"c1")),
+                n1.spawn(client(b"c2")),
+                n1.spawn(client(b"c3")),
+            )
+
+        rt.block_on(main())
+        return log
+
+    assert run(42) == run(42)
+    assert run(42) != run(43) or True  # different seeds may differ
+
+    rt = None  # noqa: F841
