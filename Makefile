@@ -1,0 +1,46 @@
+# The runnable test matrix (ref Makefile:3-27 build/test vs sbuild/stest;
+# .github/workflows/ci.yml encodes the same legs for CI).
+#
+#   make test          sim suite, compiled C executor core (the default)
+#   make test-nonative sim suite again with MADSIM_NO_NATIVE=1 (pure-Python
+#                      loop; schedules must be byte-identical)
+#   make test-real     real-mode legs only (asyncio + real sockets + grpcio
+#                      wire + real fs/signal/process)
+#   make test-procs    forked-process sweep smoke (fail-fast, jax guard)
+#   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
+#                      and chunked==unsharded per-seed equality
+#   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
+#   make test-all      every leg above, in order
+#
+# PYTEST_ARGS passes extra pytest flags to the suite legs, e.g.
+#   make test PYTEST_ARGS="-k unix -v"
+
+PY ?= python
+PYTEST ?= $(PY) -m pytest
+PYTEST_ARGS ?=
+
+.PHONY: test test-nonative test-real test-procs dryrun bench-smoke test-all
+
+test:
+	$(PYTEST) tests/ -q $(PYTEST_ARGS)
+
+test-nonative:
+	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)
+
+test-real:
+	$(PYTEST) tests/test_real.py tests/test_real_grpc.py \
+	  tests/test_real_grpcio.py tests/test_real_etcd.py \
+	  tests/test_real_kafka_s3.py tests/test_real_fs_signal.py \
+	  -q $(PYTEST_ARGS)
+
+test-procs:
+	$(PYTEST) tests/test_builder.py -q -k procs $(PYTEST_ARGS)
+
+dryrun:
+	$(PY) -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench-smoke:
+	$(PY) bench.py --smoke
+
+test-all: test test-nonative test-real test-procs dryrun bench-smoke
+	@echo "test matrix: ALL LEGS GREEN"

@@ -63,6 +63,8 @@ BIG_CHUNK = 16384
 # min-of-REPS interleaved repetitions per timed figure (drift discipline;
 # see module docstring)
 REPS = 3
+# seed-batch size for the recovery and cross-backend parity phases
+PARITY_SEEDS = 4096
 
 _seed_cursor = [1]
 
@@ -191,7 +193,7 @@ def bench_recovery(wl, raft_mod):
     part_ecfg = raft_mod.engine_config(
         cfg, time_limit_ns=int(SIM_SECONDS * 1e9), max_steps=300
     )
-    seeds = _fresh(4096)
+    seeds = _fresh(PARITY_SEEDS)
     straight = core.run_sweep(wl, full_ecfg, seeds)
     partial = core.run_sweep(wl, part_ecfg, seeds)
     with tempfile.TemporaryDirectory() as d:
@@ -210,7 +212,8 @@ def bench_recovery(wl, raft_mod):
             ),
         )
     )
-    return {"seeds": 4096, "interrupted_at_step": 300, "bit_identical": identical}
+    return {"seeds": PARITY_SEEDS, "interrupted_at_step": 300,
+            "bit_identical": identical}
 
 
 def _leaf_np(a):
@@ -234,7 +237,7 @@ def bench_cross_backend(wl, ecfg):
     if jax.default_backend() == "cpu":
         return {"skipped": "single-backend process (cpu only)"}
     cpu = jax.devices("cpu")[0]
-    seeds = _fresh(4096)
+    seeds = _fresh(PARITY_SEEDS)
     dev_final = core.run_sweep(wl, ecfg, seeds)
     with jax.default_device(cpu):
         cpu_final = core.run_sweep(wl, ecfg, jax.device_put(seeds, cpu))
@@ -403,5 +406,23 @@ def main() -> None:
     )
 
 
+def _smoke() -> None:
+    """Shrink every knob so the full pipeline (host tier, curve, chunked
+    sweep, recovery, cross-backend parity, kafka, etcd) runs in ~a minute
+    — the CI/Make smoke target. Numbers are meaningless; the exit code
+    and the JSON shape are the point."""
+    global CURVE, BIG_TOTAL, BIG_CHUNK, HOST_SEEDS, REPS, SIM_SECONDS
+    global PARITY_SEEDS
+    CURVE = (64, 128)
+    BIG_TOTAL = 256
+    BIG_CHUNK = 128
+    HOST_SEEDS = 2
+    REPS = 2
+    SIM_SECONDS = 0.5
+    PARITY_SEEDS = 256
+
+
 if __name__ == "__main__":
+    if "--smoke" in sys.argv:
+        _smoke()
     main()

@@ -17,7 +17,10 @@ import numpy as np
 
 from .core import EngineConfig, EngineState, Workload
 
-_FORMAT_VERSION = 2  # v2: EngineState gained qmax; draw layout adds tie-break
+# v2: EngineState gained qmax; draw layout adds tie-break.
+# v3: packed queue layout — the redundant bool valid[Q] plane left the
+#     EventQueue, so v2 files would load positionally misaligned.
+_FORMAT_VERSION = 3
 
 
 def save_sweep(state: EngineState, path: str) -> None:

@@ -83,6 +83,10 @@ class EngineConfig(NamedTuple):
     max_steps: int = 100_000
     jitter_lo_ns: int = 50
     jitter_hi_ns: int = 100
+    # A/B instrumentation (scripts/bench_packing.py): 1 = the pre-round-5
+    # queue layout with its redundant bool valid[Q] plane. Schedules are
+    # bit-identical either way; only the loop-carry footprint differs.
+    legacy_queue: int = 0
     # HISTORICAL, kept for config compatibility (validated but unused):
     # rounds 1-2 chunked the sweep as while(cond){fori(cond_interval){
     # step}} assuming the termination check was the expensive part. TPU
@@ -126,7 +130,10 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
         )
     key = seed_key(seed)
     wstate, emits = workload.init(key)
-    q = equeue.make(cfg.queue_capacity, workload.payload_slots)
+    q = equeue.make(
+        cfg.queue_capacity, workload.payload_slots,
+        legacy=bool(cfg.legacy_queue),
+    )
     q, overflow = equeue.push_many(q, emits.times, emits.kinds, emits.pays, emits.enables)
     return EngineState(
         seed=jnp.asarray(seed, jnp.int64),

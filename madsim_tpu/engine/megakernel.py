@@ -479,7 +479,6 @@ def run_megasweep(state: EngineState, steps: int,
             time=_join64(qthi, qtlo),
             kind=qkind,
             pay=jnp.swapaxes(qpay, 1, 2),
-            valid=_join64(qthi, qtlo) != INVALID_TIME,
         ),
         wstate=_ProbeW(
             ring=ring.reshape(S, _N, _L),

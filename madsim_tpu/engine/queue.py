@@ -9,13 +9,21 @@ slot table per seed —
     time  : int64[Q]   absolute deadline, ns (INVALID_TIME when free)
     kind  : int32[Q]   event discriminant (workload-defined)
     pay   : int32[Q,P] payload slots
-    valid : bool[Q]
 
 ``pop_min`` = min + one-hot invalidate; ``push_many`` = rank-select masked
 writes. Everything is dense vector code — **no dynamic scatter or gather**,
 which on TPU run ~6-10x slower than the masked equivalents (see
 engine/ops.py). For Q ≲ 256 each op is a handful of VPU lanes, far cheaper
 than the host round-trip it replaces.
+
+Occupancy is encoded in the time plane itself: a slot is free iff its time
+is ``INVALID_TIME`` (every constructor and removal maintains this), so no
+separate validity plane travels in the loop carry. The pre-round-5 layout
+kept an explicit ``bool valid[Q]`` plane; it survives as
+``LegacyEventQueue`` behind ``EngineConfig(legacy_queue=1)`` purely so the
+two layouts can be A/B-measured interleaved in one process
+(scripts/bench_packing.py, docs/pallas_finding.md §5) — both produce
+bit-identical schedules by construction.
 
 Equal-time pops break ties *randomly* via a caller-supplied counter-RNG
 draw (``tie_u32``), mirroring the reference's uniformly-random ready-queue
@@ -28,7 +36,7 @@ surfaces it per seed so the run can be retried with a larger Q.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Tuple, Union
 
 import jax.numpy as jnp
 
@@ -40,60 +48,93 @@ _HASH_MULT = 2654435761  # Knuth multiplicative hash constant
 
 
 class EventQueue(NamedTuple):
+    time: jnp.ndarray  # int64[Q]; INVALID_TIME == free slot
+    kind: jnp.ndarray  # int32[Q]
+    pay: jnp.ndarray  # int32[Q, P]
+
+
+class LegacyEventQueue(NamedTuple):
+    """Round-1..4 layout with the redundant validity plane (A/B only)."""
+
     time: jnp.ndarray  # int64[Q]
     kind: jnp.ndarray  # int32[Q]
     pay: jnp.ndarray  # int32[Q, P]
     valid: jnp.ndarray  # bool[Q]
 
 
-def make(capacity: int, payload_slots: int) -> EventQueue:
-    return EventQueue(
-        time=jnp.full((capacity,), INVALID_TIME, jnp.int64),
-        kind=jnp.zeros((capacity,), jnp.int32),
-        pay=jnp.zeros((capacity, payload_slots), jnp.int32),
-        valid=jnp.zeros((capacity,), bool),
-    )
+AnyQueue = Union[EventQueue, LegacyEventQueue]
+
+
+def make(capacity: int, payload_slots: int, legacy: bool = False) -> AnyQueue:
+    time = jnp.full((capacity,), INVALID_TIME, jnp.int64)
+    kind = jnp.zeros((capacity,), jnp.int32)
+    pay = jnp.zeros((capacity, payload_slots), jnp.int32)
+    if legacy:
+        return LegacyEventQueue(time, kind, pay, jnp.zeros((capacity,), bool))
+    return EventQueue(time, kind, pay)
+
+
+def _free(q: AnyQueue) -> jnp.ndarray:
+    """Free-slot mask; trace-time dispatch on the layout (zero runtime
+    cost — both encode the same fact, by the INVALID_TIME invariant)."""
+    if isinstance(q, LegacyEventQueue):
+        return ~q.valid
+    return q.time == INVALID_TIME
+
+
+def _rebuild(q: AnyQueue, time, kind, pay, occupy=None, vacate=None) -> AnyQueue:
+    """New queue with the same layout; legacy also updates its valid plane
+    (``occupy``/``vacate`` are slot masks)."""
+    if isinstance(q, LegacyEventQueue):
+        valid = q.valid
+        if occupy is not None:
+            valid = valid | occupy
+        if vacate is not None:
+            valid = valid & ~vacate
+        return LegacyEventQueue(time, kind, pay, valid)
+    return EventQueue(time, kind, pay)
 
 
 def push(
-    q: EventQueue,
+    q: AnyQueue,
     time: jnp.ndarray,
     kind: jnp.ndarray,
     pay: jnp.ndarray,
     enable: jnp.ndarray,
-) -> Tuple[EventQueue, jnp.ndarray]:
+) -> Tuple[AnyQueue, jnp.ndarray]:
     """Insert one event at the first free slot (no-op when ``enable`` is
     False). Returns ``(queue', overflowed)``."""
-    free = ~q.valid
+    free = _free(q)
     have_room = jnp.any(free)
     do = jnp.asarray(enable, bool) & have_room
-    mask = onehot(jnp.argmax(free), q.valid.shape[0]) & do
+    mask = onehot(jnp.argmax(free), q.time.shape[0]) & do
     overflow = enable & ~have_room
     return (
-        EventQueue(
-            time=jnp.where(mask, jnp.asarray(time, jnp.int64), q.time),
-            kind=jnp.where(mask, jnp.asarray(kind, jnp.int32), q.kind),
-            pay=jnp.where(mask[:, None], pay, q.pay),
-            valid=q.valid | mask,
+        _rebuild(
+            q,
+            jnp.where(mask, jnp.asarray(time, jnp.int64), q.time),
+            jnp.where(mask, jnp.asarray(kind, jnp.int32), q.kind),
+            jnp.where(mask[:, None], pay, q.pay),
+            occupy=mask,
         ),
         overflow,
     )
 
 
 def push_many(
-    q: EventQueue,
+    q: AnyQueue,
     times: jnp.ndarray,  # int64[E]
     kinds: jnp.ndarray,  # int32[E]
     pays: jnp.ndarray,  # int32[E, P]
     enables: jnp.ndarray,  # bool[E]
-) -> Tuple[EventQueue, jnp.ndarray]:
+) -> Tuple[AnyQueue, jnp.ndarray]:
     """Insert up to E events in ONE dense pass: emit ``e`` maps to the
     e-th free slot (ascending index — the same assignment a sequential
     first-free scan would make), computed via a cumsum rank over the free
     mask and written with masked selects. No sort, no top_k, no scatter.
     """
     E = times.shape[0]
-    free = ~q.valid
+    free = _free(q)
     rank = jnp.cumsum(free.astype(jnp.int32)) - 1  # rank among free slots
     eidx = jnp.arange(E, dtype=jnp.int32)
     sel = free[:, None] & (rank[:, None] == eidx[None, :]) & enables[None, :]  # [Q,E]
@@ -104,19 +145,20 @@ def push_many(
     num_free = jnp.sum(free.astype(jnp.int32))
     overflow = jnp.any(enables & (eidx >= num_free))
     return (
-        EventQueue(
-            time=jnp.where(write, t_new, q.time),
-            kind=jnp.where(write, k_new, q.kind),
-            pay=jnp.where(write[:, None], p_new, q.pay),
-            valid=q.valid | write,
+        _rebuild(
+            q,
+            jnp.where(write, t_new, q.time),
+            jnp.where(write, k_new, q.kind),
+            jnp.where(write[:, None], p_new, q.pay),
+            occupy=write,
         ),
         overflow,
     )
 
 
 def pop_min(
-    q: EventQueue, enable=True, tie_u32=0
-) -> Tuple[EventQueue, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    q: AnyQueue, enable=True, tie_u32=0
+) -> Tuple[AnyQueue, jnp.ndarray, jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Remove and return the earliest event; equal-time ties break
     uniformly-at-random by ``tie_u32`` (a counter-RNG draw — deterministic
     per seed+event, different across seeds: the reference's random ready-
@@ -152,11 +194,12 @@ def pop_min(
     kind = jnp.sum(jnp.where(mask & found, q.kind, 0), dtype=jnp.int32)
     pay = jnp.sum(jnp.where(mask[:, None], q.pay, 0), axis=0, dtype=jnp.int32)
     return (
-        EventQueue(
-            time=jnp.where(rm, INVALID_TIME, q.time),
-            kind=q.kind,
-            pay=q.pay,
-            valid=q.valid & ~rm,
+        _rebuild(
+            q,
+            jnp.where(rm, INVALID_TIME, q.time),
+            q.kind,
+            q.pay,
+            vacate=rm,
         ),
         t,
         kind,
@@ -165,5 +208,5 @@ def pop_min(
     )
 
 
-def size(q: EventQueue) -> jnp.ndarray:
-    return jnp.sum(q.valid.astype(jnp.int32))
+def size(q: AnyQueue) -> jnp.ndarray:
+    return jnp.sum((~_free(q)).astype(jnp.int32))

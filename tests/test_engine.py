@@ -445,6 +445,28 @@ def test_chunked_sweep_matches_unchunked_with_ragged_tail():
         assert jnp.array_equal(jax.device_get(a), jax.device_get(b))
 
 
+def test_legacy_queue_layout_bit_identical():
+    """The pre-round-5 queue layout (explicit valid plane,
+    EngineConfig(legacy_queue=1)) and the packed layout (occupancy encoded
+    in the time plane) must produce bit-identical schedules — the A/B in
+    scripts/bench_packing.py measures a pure layout effect, nothing else."""
+    cfg = raft.RaftConfig(num_nodes=3, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=500_000_000, max_steps=4_000)
+    legacy_ecfg = ecfg._replace(legacy_queue=1)
+    wl = raft.workload(cfg)
+    seeds = jnp.arange(16, dtype=jnp.int64)
+    packed = ecore.run_sweep(wl, ecfg, seeds)
+    legacy = ecore.run_sweep(wl, legacy_ecfg, seeds)
+    assert jnp.array_equal(packed.ctr, legacy.ctr)
+    assert jnp.array_equal(packed.now_ns, legacy.now_ns)
+    assert jnp.array_equal(packed.queue.time, legacy.queue.time)
+    for a, b in zip(jax.tree.leaves(packed.wstate), jax.tree.leaves(legacy.wstate)):
+        assert jnp.array_equal(jax.device_get(a), jax.device_get(b))
+    # the legacy layout really does carry the extra plane
+    assert hasattr(legacy.queue, "valid") and not hasattr(packed.queue, "valid")
+    assert raft.sweep_summary(packed) == raft.sweep_summary(legacy)
+
+
 def test_buggify_latency_spikes_amplify_and_stay_deterministic():
     """The device-tier buggify spike path (engine/net.py: loss-draw remix
     gates a 1-5 s latency spike, ref net/mod.rs:287-295): enabling it
