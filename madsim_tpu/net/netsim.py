@@ -131,14 +131,22 @@ class NetSim(Simulator):
         """Raw virtual sleep without the 1 ms tokio minimum."""
         return _new_sleep(self.time, self.time.now_ns + max(0, int(ns)))
 
-    async def rand_delay(self) -> None:
+    def rand_delay(self) -> Sleep:
         """0-5 µs processing delay; buggified to 1-5 s at 10%
-        (ref net/mod.rs:287-295)."""
+        (ref net/mod.rs:287-295).
+
+        Plain function returning the awaitable Sleep (``await
+        ns.rand_delay()`` reads the same): an ``async def`` here costs a
+        generator frame + an extra send() dispatch on EVERY message hop
+        (twice per delivered message — it's the hottest helper in the
+        host-tier profile). Draw order is unchanged: the draws run at
+        call time, which under the single-threaded executor is the same
+        poll in which the returned Sleep is first awaited."""
         if self.rng.buggify_with_prob(0.1):
             delay_ns = self.rng.gen_range(1_000_000_000, 5_000_000_001)
         else:
             delay_ns = self.rng.gen_range(0, 5_001)
-        await self._sleep_ns(delay_ns)
+        return self._sleep_ns(delay_ns)
 
     def resolve_host(self, addr: "str | Addr") -> Addr:
         """DNS-resolve a "host:port" string (ref addr.rs:255-257)."""
