@@ -55,12 +55,28 @@ class Topic:
     next_rr: int = 0  # round-robin cursor for keyless produce
 
 
+@dataclass
+class Group:
+    """One consumer group: membership, the range assignment of the
+    current generation, and committed offsets. **Beyond the reference**
+    — madsim-rdkafka's sim models no consumer groups at all (assignment
+    is manual, consumer.rs); this is classic group semantics with a
+    deterministic assignor so sim schedules stay reproducible."""
+
+    members: Dict[str, List[str]] = field(default_factory=dict)  # id -> topics
+    generation: int = 0
+    assignments: Dict[str, List[Tuple[str, int]]] = field(default_factory=dict)
+    committed: Dict[Tuple[str, int], int] = field(default_factory=dict)
+    next_member: int = 0
+
+
 class Broker:
     """The single global broker (one mutex-guarded instance in the
     reference, sim_broker.rs:14-21)."""
 
     def __init__(self) -> None:
         self.topics: Dict[str, Topic] = {}
+        self.groups: Dict[str, Group] = {}
 
     # -- admin -------------------------------------------------------------
 
@@ -172,3 +188,90 @@ class Broker:
         if topic is not None:
             return {topic: len(self._topic(topic).partitions)}
         return {name: len(t.partitions) for name, t in sorted(self.topics.items())}
+
+    # -- consumer groups (beyond the reference — see Group) -----------------
+
+    def _group(self, group_id: str) -> Group:
+        """Create-on-first-use — the JOIN path only."""
+        g = self.groups.get(group_id)
+        if g is None:
+            g = self.groups[group_id] = Group()
+        return g
+
+    def _group_lookup(self, group_id: str) -> Group:
+        """Every non-join path: a typo'd group id errors instead of
+        silently creating an empty group (whose committed offsets nobody
+        would ever read)."""
+        g = self.groups.get(group_id)
+        if g is None:
+            raise KafkaBrokerError(f"unknown group: {group_id!r}")
+        return g
+
+    def _rebalance(self, g: Group) -> None:
+        """Range assignment, deterministic: for each topic, contiguous
+        partition spans over the topic's subscribers sorted by member id
+        (the classic RangeAssignor; floor+remainder split)."""
+        g.generation += 1
+        g.assignments = {m: [] for m in g.members}
+        topics = sorted({t for ts in g.members.values() for t in ts})
+        for topic in topics:
+            subs = sorted(m for m, ts in g.members.items() if topic in ts)
+            if not subs or topic not in self.topics:
+                continue
+            n_parts = len(self.topics[topic].partitions)
+            base, extra = divmod(n_parts, len(subs))
+            start = 0
+            for i, m in enumerate(subs):
+                count = base + (1 if i < extra else 0)
+                g.assignments[m].extend(
+                    (topic, p) for p in range(start, start + count)
+                )
+                start += count
+
+    def join_group(
+        self, group_id: str, member_id: Optional[str], topics: List[str]
+    ) -> Tuple[str, int, List[Tuple[str, int]]]:
+        """Add (or re-subscribe) a member; returns (member_id, generation,
+        this member's assignment). Every join triggers a rebalance, as in
+        the eager group protocol."""
+        for t in topics:
+            self._topic(t)  # unknown topics fail the join loudly
+        g = self._group(group_id)
+        if member_id is None:
+            member_id = f"member-{g.next_member}"
+            g.next_member += 1
+        g.members[member_id] = list(topics)
+        self._rebalance(g)
+        return member_id, g.generation, g.assignments[member_id]
+
+    def leave_group(self, group_id: str, member_id: str) -> None:
+        g = self._group_lookup(group_id)
+        if member_id in g.members:
+            del g.members[member_id]
+            self._rebalance(g)
+
+    def group_state(
+        self, group_id: str, member_id: str
+    ) -> Tuple[int, List[Tuple[str, int]]]:
+        """Heartbeat: (current generation, this member's assignment) —
+        consumers compare generations to detect a rebalance."""
+        g = self._group_lookup(group_id)
+        if member_id not in g.members:
+            raise KafkaBrokerError(
+                f"unknown member {member_id!r} in group {group_id!r}"
+            )
+        return g.generation, g.assignments.get(member_id, [])
+
+    def commit_offsets(
+        self, group_id: str, offsets: List[Tuple[str, int, int]]
+    ) -> None:
+        g = self._group_lookup(group_id)
+        for topic, partition, offset in offsets:
+            self._partition(topic, partition)  # validate
+            g.committed[(topic, partition)] = offset
+
+    def committed_offsets(
+        self, group_id: str, tps: List[Tuple[str, int]]
+    ) -> List[Tuple[str, int, Optional[int]]]:
+        g = self._group_lookup(group_id)
+        return [(t, p, g.committed.get((t, p))) for t, p in tps]

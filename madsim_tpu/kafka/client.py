@@ -5,8 +5,9 @@ API mirrors rust-rdkafka's shape: a string-map ``ClientConfig``
 ``FutureProducer`` with ``linger.ms`` batching delay, ``BaseConsumer`` with
 assign/seek/poll fetch loops honoring the fetch byte budgets, a
 ``StreamConsumer`` that awaits messages, and an ``AdminClient``.
-Offset commits are not modeled (the reference sim doesn't model consumer
-groups either — assignment is manual).
+Consumer groups (group.id / rebalance / committed offsets / auto-commit)
+ARE modeled — beyond the reference, whose sim leaves assignment manual
+(see BaseConsumer's docstring and broker.py ``Group``).
 """
 
 from __future__ import annotations
@@ -166,7 +167,10 @@ class FutureProducer:
 class _Assignment:
     topic: str
     partition: int
-    position: int  # next offset to fetch
+    position: int  # next offset to FETCH (fetch batches run ahead)
+    consumed: int = 0  # next offset after the last message RETURNED by poll
+    # (commits use `consumed`, not `position`: a fetch batch sitting
+    # unread in the client buffer must not be committed away)
 
 
 class TopicPartitionList:
@@ -186,7 +190,17 @@ class TopicPartitionList:
 
 class BaseConsumer:
     """assign/seek/poll fetch loop (sim consumer; fetch byte budgets from
-    config: fetch.max.bytes / max.partition.fetch.bytes)."""
+    config: fetch.max.bytes / max.partition.fetch.bytes).
+
+    With a ``group.id`` in the config, ``subscribe`` joins a broker-side
+    consumer group (range assignor, eager rebalance, committed offsets —
+    **beyond the reference**, whose sim has no groups): partitions are
+    split across the group's members, a generation bump observed at the
+    next poll triggers reassignment from committed offsets, and
+    ``enable.auto.commit`` (default true, interval
+    ``auto.commit.interval.ms``) commits consumed positions on poll.
+    Without a group id, ``subscribe`` keeps the reference sim's semantics:
+    the consumer takes every partition from the low watermark."""
 
     POLL_TICK_S = 0.01
 
@@ -201,17 +215,93 @@ class BaseConsumer:
         self._assignments: List[_Assignment] = []
         self._buffer: List[OwnedMessage] = []
         self._rr = 0
+        self._group = config.get("group.id")
+        self._member: Optional[str] = None
+        self._generation = -1
+        self._auto_commit = config.get("enable.auto.commit", "true") == "true"
+        self._commit_interval_s = (
+            config.get_float("auto.commit.interval.ms", 5000.0) / 1000.0
+        )
+        self._last_commit = None  # Instant of the last auto-commit
 
     async def subscribe(self, topics: List[str]) -> None:
-        """Assign every partition of the topics from the beginning (no
-        consumer groups in the sim — subscription = full assignment).
-        Replaces any previous subscription, like rdkafka's subscribe."""
+        """Replaces any previous subscription, like rdkafka's subscribe.
+        Group mode (``group.id`` set): join the group and take the range
+        assignment. Groupless: assign every partition from the beginning
+        (the reference sim's subscription = full assignment)."""
         self._assignments.clear()
         self._buffer.clear()
+        if self._group is not None:
+            member, gen, assigned = await self._conn.call(
+                ("join_group", self._group, self._member, list(topics))
+            )
+            self._member = member
+            await self._apply_assignment(gen, assigned)
+            return
         for topic in topics:
             meta = await self._conn.call(("metadata", topic))
             for p in range(meta[topic]):
                 await self._assign_one(topic, p, None)
+
+    async def _apply_assignment(
+        self, generation: int, assigned: List[Tuple[str, int]]
+    ) -> None:
+        """Adopt a group assignment: start each partition at its committed
+        offset, or the low watermark when nothing was ever committed."""
+        self._generation = generation
+        self._assignments.clear()
+        self._buffer.clear()
+        self._rr = 0
+        committed = await self._conn.call(
+            ("committed", self._group, list(assigned))
+        )
+        for topic, partition, offset in committed:
+            await self._assign_one(topic, partition, offset)
+
+    async def _maybe_rebalance(self) -> None:
+        """Group heartbeat: adopt the new assignment when the generation
+        moved (another member joined or left). Commits consumed positions
+        FIRST when auto-commit is on (librdkafka's commit-on-revoke) — a
+        healthy rebalance must not re-deliver messages the application
+        already saw just because the commit interval hadn't elapsed."""
+        gen, assigned = await self._conn.call(
+            ("heartbeat", self._group, self._member)
+        )
+        if gen != self._generation:
+            if self._auto_commit and self._generation >= 0:
+                await self.commit()
+            await self._apply_assignment(gen, assigned)
+
+    async def commit(self) -> None:
+        """Commit the current consume positions (rdkafka commit_consumer_
+        state shape). No-op outside a group."""
+        if self._group is None or not self._assignments:
+            return
+        await self._conn.call(
+            ("commit", self._group,
+             [(a.topic, a.partition, a.consumed) for a in self._assignments])
+        )
+
+    async def committed(self, tpl: "TopicPartitionList") -> List[Tuple[str, int, Optional[int]]]:
+        """The group's committed offsets for the listed partitions."""
+        if self._group is None:
+            raise KafkaError("committed() requires a group.id")
+        return await self._conn.call(
+            ("committed", self._group,
+             [(t, p) for t, p, _o in tpl.elements])
+        )
+
+    async def unsubscribe(self) -> None:
+        """Leave the group (triggering a rebalance for the survivors) and
+        drop all assignments."""
+        if self._group is not None and self._member is not None:
+            if self._auto_commit:
+                await self.commit()
+            await self._conn.call(("leave_group", self._group, self._member))
+            self._member = None
+            self._generation = -1
+        self._assignments.clear()
+        self._buffer.clear()
 
     async def assign(self, tpl: TopicPartitionList) -> None:
         self._assignments.clear()
@@ -223,12 +313,15 @@ class BaseConsumer:
         if offset is None:
             wm: Watermarks = await self._conn.call(("watermarks", topic, partition))
             offset = wm.low
-        self._assignments.append(_Assignment(topic, partition, offset))
+        self._assignments.append(
+            _Assignment(topic, partition, offset, consumed=offset)
+        )
 
     def seek(self, topic: str, partition: int, offset: int) -> None:
         for a in self._assignments:
             if a.topic == topic and a.partition == partition:
                 a.position = offset
+                a.consumed = offset
                 self._buffer = [
                     m for m in self._buffer
                     if not (m.topic == topic and m.partition == partition)
@@ -255,15 +348,52 @@ class BaseConsumer:
 
     async def poll(self, timeout_s: float = 1.0) -> Optional[OwnedMessage]:
         deadline = self._now_instant() + timeout_s
+        heartbeated = False
         while True:
             if self._buffer:
-                return self._buffer.pop(0)
+                # buffered message ready: no broker round-trips at all —
+                # draining a fetch batch must not pay a heartbeat per
+                # message (rebalance detection waits for the next empty
+                # poll, like librdkafka's background-interval heartbeat)
+                return self._consume(self._buffer.pop(0))
+            if (
+                self._group is not None
+                and self._member is not None
+                and not heartbeated
+            ):
+                # at most one heartbeat per poll() call (idle 1 s polls
+                # spin ~100 ticks; re-heartbeating each tick buys nothing)
+                heartbeated = True
+                await self._maybe_rebalance()
+                await self._maybe_auto_commit()
+                if self._buffer:  # rebalance may not clear a fresh fetch
+                    return self._consume(self._buffer.pop(0))
             await self._fetch_round()
             if self._buffer:
-                return self._buffer.pop(0)
+                return self._consume(self._buffer.pop(0))
             if self._now_instant() >= deadline:
                 return None
             await self._sleep(self.POLL_TICK_S)
+
+    def _consume(self, msg: OwnedMessage) -> OwnedMessage:
+        for a in self._assignments:
+            if a.topic == msg.topic and a.partition == msg.partition:
+                a.consumed = msg.offset + 1
+                break
+        return msg
+
+    async def _maybe_auto_commit(self) -> None:
+        """Commit positions once per auto.commit.interval.ms of virtual
+        time (rdkafka's enable.auto.commit behavior)."""
+        if not self._auto_commit:
+            return
+        now = self._now_instant()
+        if self._last_commit is None:
+            self._last_commit = now
+            return
+        if now >= self._last_commit + self._commit_interval_s:
+            await self.commit()
+            self._last_commit = now
 
     async def fetch_watermarks(
         self, topic: str, partition: int, _timeout_s: float = 1.0

@@ -2,7 +2,9 @@
 
 ``SimBroker().serve(addr)``: one request enum exchange per ``connect1``
 connection — CreateTopic / DeleteTopic / Produce / Fetch / FetchMetadata /
-FetchWatermarks / OffsetsForTimes (sim_broker.rs:14-77).
+FetchWatermarks / OffsetsForTimes (sim_broker.rs:14-77) — plus the
+consumer-group ops (join/leave/heartbeat/commit/committed), which the
+reference sim does not model (broker.py ``Group``).
 """
 
 from __future__ import annotations
@@ -80,4 +82,21 @@ class SimBroker:
             return b.offsets_for_times(req[1])
         if op == "metadata":
             return b.metadata(req[1])
+        if op == "join_group":
+            _, group, member, topics = req
+            return b.join_group(group, member, topics)
+        if op == "leave_group":
+            _, group, member = req
+            b.leave_group(group, member)
+            return None
+        if op == "heartbeat":
+            _, group, member = req
+            return b.group_state(group, member)
+        if op == "commit":
+            _, group, offsets = req
+            b.commit_offsets(group, offsets)
+            return None
+        if op == "committed":
+            _, group, tps = req
+            return b.committed_offsets(group, tps)
         raise KafkaBrokerError(f"unknown request {op!r}")

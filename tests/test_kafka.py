@@ -303,3 +303,208 @@ def test_kafka_determinism():
         return main()
 
     ms.Runtime.check_determinism(49, workload)
+
+
+# -- consumer groups (beyond the reference: its sim has no groups) ----------
+
+
+def gcfg(group: str, auto: bool = True) -> ClientConfig:
+    c = cfg().set("group.id", group)
+    if not auto:
+        c.set("enable.auto.commit", "false")
+    return c
+
+
+def test_group_splits_partitions_across_members():
+    """Two members of one group range-split 4 partitions 2/2 and together
+    consume every message exactly once."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g1", 4)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(12):
+            await producer.send(BaseRecord.to("g1").with_payload(f"m{i}"))
+
+        a = await gcfg("grp").create(BaseConsumer)
+        b = await gcfg("grp").create(BaseConsumer)
+        await a.subscribe(["g1"])
+        await b.subscribe(["g1"])
+        # b's join bumped the generation; a adopts it at next poll
+        got_a, got_b = [], []
+        for _ in range(24):
+            m = await a.poll(timeout_s=0.1)
+            if m:
+                got_a.append(m.payload.decode())
+            m = await b.poll(timeout_s=0.1)
+            if m:
+                got_b.append(m.payload.decode())
+        assert len(a._assignments) == 2 and len(b._assignments) == 2
+        assert {x.partition for x in a._assignments}.isdisjoint(
+            {x.partition for x in b._assignments}
+        )
+        assert sorted(got_a + got_b) == sorted(f"m{i}" for i in range(12))
+
+    with_broker(900, run)
+
+
+def test_group_rebalance_on_join_and_leave():
+    """A lone member holds all partitions; a joiner halves them; a leave
+    returns them (eager rebalance via generation bump on heartbeat)."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g2", 4)])
+        a = await gcfg("grp2").create(BaseConsumer)
+        await a.subscribe(["g2"])
+        assert len(a._assignments) == 4
+
+        b = await gcfg("grp2").create(BaseConsumer)
+        await b.subscribe(["g2"])
+        await a.poll(timeout_s=0.05)  # observe the new generation
+        assert len(a._assignments) == 2 and len(b._assignments) == 2
+
+        await b.unsubscribe()
+        await a.poll(timeout_s=0.05)
+        assert len(a._assignments) == 4
+
+    with_broker(901, run)
+
+
+def test_group_commit_and_resume():
+    """Committed offsets survive a member's departure: a successor in the
+    same group resumes where the predecessor committed, not from the log
+    start; a fresh group still starts from the beginning."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g3", 1)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(6):
+            await producer.send(BaseRecord.to("g3").with_payload(f"m{i}"))
+
+        first = await gcfg("grp3", auto=False).create(BaseConsumer)
+        await first.subscribe(["g3"])
+        for _ in range(3):
+            m = await first.poll(timeout_s=0.5)
+            assert m is not None
+        await first.commit()
+        await first.unsubscribe()
+
+        second = await gcfg("grp3", auto=False).create(BaseConsumer)
+        await second.subscribe(["g3"])
+        m = await second.poll(timeout_s=0.5)
+        assert m is not None and m.payload == b"m3"  # resumed, no replay
+
+        fresh = await gcfg("other", auto=False).create(BaseConsumer)
+        await fresh.subscribe(["g3"])
+        m = await fresh.poll(timeout_s=0.5)
+        assert m is not None and m.payload == b"m0"  # new group: log start
+
+    with_broker(902, run)
+
+
+def test_group_auto_commit_on_unsubscribe():
+    """enable.auto.commit (the default) commits positions when the member
+    leaves, so a successor resumes without an explicit commit()."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g4", 1)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(4):
+            await producer.send(BaseRecord.to("g4").with_payload(f"m{i}"))
+
+        first = await gcfg("grp4").create(BaseConsumer)
+        await first.subscribe(["g4"])
+        for _ in range(2):
+            assert await first.poll(timeout_s=0.5) is not None
+        await first.unsubscribe()  # auto-commits
+
+        second = await gcfg("grp4").create(BaseConsumer)
+        await second.subscribe(["g4"])
+        m = await second.poll(timeout_s=0.5)
+        assert m is not None and m.payload == b"m2"
+
+    with_broker(903, run)
+
+
+def test_group_rebalance_commits_consumed_before_revoke():
+    """A healthy rebalance must not re-deliver messages the application
+    already consumed: with auto-commit on (default), the member commits
+    consumed positions before adopting the new assignment, even though
+    the 5 s auto-commit interval never elapsed (commit-on-revoke)."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g6", 1)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(6):
+            await producer.send(BaseRecord.to("g6").with_payload(f"m{i}"))
+
+        a = await gcfg("grp6").create(BaseConsumer)
+        await a.subscribe(["g6"])
+        seen = []
+        for _ in range(3):
+            m = await a.poll(timeout_s=0.5)
+            seen.append(m.payload.decode())
+        assert seen == ["m0", "m1", "m2"]
+
+        b = await gcfg("grp6").create(BaseConsumer)
+        await b.subscribe(["g6"])  # generation bump; a must commit first
+        got = []
+        for _ in range(10):
+            for c in (a, b):
+                m = await c.poll(timeout_s=0.05)
+                if m:
+                    got.append(m.payload.decode())
+        # the single partition landed on exactly one member, which resumed
+        # at the committed position — m0-m2 never re-delivered
+        assert got == ["m3", "m4", "m5"]
+
+    with_broker(904, run)
+
+
+def test_group_ops_on_unknown_group_error():
+    """commit/committed/heartbeat against a group nobody ever joined must
+    error by name, not silently materialize an empty group."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g7", 1)])
+        c = await gcfg("nojoin", auto=False).create(BaseConsumer)
+        # no subscribe -> the group never exists broker-side
+        tpl = TopicPartitionList().add_partition("g7", 0)
+        with pytest.raises(KafkaError, match="unknown group"):
+            await c.committed(tpl)
+
+    with_broker(905, run)
+
+
+def test_group_determinism():
+    """Same seed => identical group consumption interleaving."""
+
+    def run_once(seed):
+        async def run():
+            admin = await cfg().create(AdminClient)
+            await admin.create_topics([NewTopic.new("g5", 3)])
+            producer = await cfg().create(FutureProducer)
+            for i in range(9):
+                await producer.send(BaseRecord.to("g5").with_payload(f"m{i}"))
+            a = await gcfg("grp5").create(BaseConsumer)
+            b = await gcfg("grp5").create(BaseConsumer)
+            await a.subscribe(["g5"])
+            await b.subscribe(["g5"])
+            log = []
+            for _ in range(18):
+                m = await a.poll(timeout_s=0.1)
+                if m:
+                    log.append(("a", m.partition, m.offset))
+                m = await b.poll(timeout_s=0.1)
+                if m:
+                    log.append(("b", m.partition, m.offset))
+            return log
+
+        return with_broker(seed, run)
+
+    assert run_once(77) == run_once(77)
