@@ -80,6 +80,8 @@ class GetOptions:
     revision: int = 0
     count_only: bool = False
     keys_only: bool = False
+    #: etcd's from-key convention (range_end = "\0"): every key >= key
+    from_key: bool = False
 
     def with_prefix(self) -> "GetOptions":
         self.prefix = True
@@ -107,6 +109,8 @@ class DeleteOptions:
     prefix: bool = False
     range_end: Optional[bytes] = None
     prev_kv: bool = False
+    #: etcd's from-key convention (range_end = "\0"): every key >= key
+    from_key: bool = False
 
     def with_prefix(self) -> "DeleteOptions":
         self.prefix = True
@@ -130,12 +134,19 @@ class CompareOp(Enum):
 
 @dataclass
 class Compare:
-    """Txn guard: compare a key's value/revision/version/lease."""
+    """Txn guard: compare a key's value/revision/version/lease.
+
+    With ``range_end`` (or ``from_key``) set this is a RANGE compare
+    (etcd >= 3.3): the predicate must hold for EVERY key in the range;
+    an empty range is evaluated against the missing-key defaults (so the
+    "no key in range exists" idiom — version == 0 — holds vacuously)."""
 
     key: bytes
     target: str  # "value" | "version" | "create_revision" | "mod_revision" | "lease"
     op: CompareOp
     operand: Any
+    range_end: Optional[bytes] = None
+    from_key: bool = False
 
     @staticmethod
     def value(key: "str | bytes", op: CompareOp, v: "str | bytes") -> "Compare":
@@ -273,8 +284,16 @@ class EtcdService:
 
     # -- kv ----------------------------------------------------------------
 
-    def _select(self, key: bytes, prefix: bool, range_end: Optional[bytes]) -> List[KeyValue]:
-        if range_end is not None:
+    def _select(
+        self,
+        key: bytes,
+        prefix: bool,
+        range_end: Optional[bytes],
+        from_key: bool = False,
+    ) -> List[KeyValue]:
+        if from_key:
+            items = [kv for k, kv in self.kv.items() if k >= key]
+        elif range_end is not None:
             items = [kv for k, kv in self.kv.items() if key <= k < range_end]
         elif prefix:
             items = [kv for k, kv in self.kv.items() if k.startswith(key)]
@@ -308,7 +327,10 @@ class EtcdService:
         return self.revision, prev if options.prev_kv else None
 
     def get(self, key: bytes, options: GetOptions) -> Tuple[int, List[KeyValue], int]:
-        items = self._select(key, options.prefix, options.range_end)
+        items = self._select(
+            key, options.prefix, options.range_end,
+            getattr(options, "from_key", False),
+        )
         count = len(items)
         if options.limit:
             items = items[: options.limit]
@@ -323,7 +345,10 @@ class EtcdService:
         return self.revision, items, count
 
     def delete(self, key: bytes, options: DeleteOptions) -> Tuple[int, int, List[KeyValue]]:
-        items = self._select(key, options.prefix, options.range_end)
+        items = self._select(
+            key, options.prefix, options.range_end,
+            getattr(options, "from_key", False),
+        )
         if items:
             self.revision += 1
         for kv in items:
@@ -344,7 +369,16 @@ class EtcdService:
         return self.revision, succeeded, results
 
     def _check(self, c: Compare) -> bool:
-        kv = self.kv.get(c.key)
+        if c.range_end is not None or c.from_key:
+            # range compare: must hold for every key in the range; empty
+            # range -> evaluate once against missing-key defaults
+            items = self._select(c.key, False, c.range_end, c.from_key)
+            if not items:
+                return self._check_one(None, c)
+            return all(self._check_one(kv, c) for kv in items)
+        return self._check_one(self.kv.get(c.key), c)
+
+    def _check_one(self, kv: Optional[KeyValue], c: Compare) -> bool:
         if c.target == "value":
             actual: Any = kv.value if kv else b""
         elif kv is None:

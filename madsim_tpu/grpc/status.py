@@ -90,6 +90,10 @@ class Status(Exception):
         return cls(Code.ABORTED, msg)
 
     @classmethod
+    def out_of_range(cls, msg: str = "") -> "Status":
+        return cls(Code.OUT_OF_RANGE, msg)
+
+    @classmethod
     def unimplemented(cls, msg: str = "") -> "Status":
         return cls(Code.UNIMPLEMENTED, msg)
 

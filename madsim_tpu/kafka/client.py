@@ -261,9 +261,12 @@ class BaseConsumer:
     async def _maybe_rebalance(self) -> None:
         """Group heartbeat: adopt the new assignment when the generation
         moved (another member joined or left). Commits consumed positions
-        FIRST when auto-commit is on (librdkafka's commit-on-revoke) — a
-        healthy rebalance must not re-deliver messages the application
-        already saw just because the commit interval hadn't elapsed."""
+        FIRST when auto-commit is on (librdkafka's commit-on-revoke),
+        which narrows — but, as in Kafka's eager protocol, cannot close —
+        the at-least-once redelivery window: a member that fetches a
+        handed-over partition BEFORE the old owner's next poll commits
+        will re-deliver that owner's uncommitted tail. Exactly-once needs
+        explicit commit() discipline, same as the real system."""
         gen, assigned = await self._conn.call(
             ("heartbeat", self._group, self._member)
         )

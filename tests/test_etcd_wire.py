@@ -1,0 +1,324 @@
+"""etcd v3 gRPC wire tests: a STOCK gRPC client (plain multicallables —
+exactly what etcd's generated stubs expand to, same wire bytes) driving
+the framework's EtcdService over genuine gRPC (madsim_tpu/etcd/wire.py).
+The analogue of madsim-etcd-client's std mode speaking real etcd gRPC."""
+
+import pytest
+
+grpcio = pytest.importorskip("grpc")
+
+from grpc import aio as grpc_aio  # noqa: E402
+
+from madsim_tpu import real  # noqa: E402
+from madsim_tpu.etcd import wire  # noqa: E402
+
+
+async def _start():
+    server = wire.WireServer()
+    task = real.spawn(server.serve(("127.0.0.1", 0)))
+    while server.bound_addr is None:
+        if task.done():
+            task.result()
+        await real.sleep(0.005)
+    host, port = server.bound_addr
+    return server, task, f"{host}:{port}"
+
+
+def _mc(ch, m, service, method, req_cls, rsp_cls):
+    return ch.unary_unary(
+        f"/etcdserverpb.{service}/{method}",
+        request_serializer=req_cls.SerializeToString,
+        response_deserializer=rsp_cls.FromString,
+    )
+
+
+def _msgs():
+    pkg = wire.wire_pkg()
+    return {n.rsplit(".", 1)[-1]: c for n, c in pkg.messages.items()}
+
+
+def test_wire_kv_put_range_delete():
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+            dele = _mc(ch, m, "KV", "DeleteRange",
+                       m["DeleteRangeRequest"], m["DeleteRangeResponse"])
+
+            r = await put(m["PutRequest"](key=b"foo", value=b"bar"))
+            assert r.header.revision == 1
+
+            # single key
+            r = await rng(m["RangeRequest"](key=b"foo"))
+            assert len(r.kvs) == 1 and r.kvs[0].value == b"bar"
+            assert r.kvs[0].create_revision == 1 and r.kvs[0].version == 1
+
+            # overwrite bumps version + mod_revision
+            await put(m["PutRequest"](key=b"foo", value=b"baz"))
+            r = await rng(m["RangeRequest"](key=b"foo"))
+            assert r.kvs[0].version == 2 and r.kvs[0].mod_revision == 2
+
+            # prefix range, range_end computed the way stock clients do
+            for k in (b"k1", b"k2", b"k3", b"z"):
+                await put(m["PutRequest"](key=k, value=b"v" + k))
+            r = await rng(m["RangeRequest"](key=b"k", range_end=b"l"))
+            assert [kv.key for kv in r.kvs] == [b"k1", b"k2", b"k3"]
+            assert r.count == 3 and not r.more
+
+            # limit + more flag
+            r = await rng(m["RangeRequest"](key=b"k", range_end=b"l", limit=2))
+            assert len(r.kvs) == 2 and r.more and r.count == 3
+
+            # count_only
+            r = await rng(m["RangeRequest"](key=b"k", range_end=b"l",
+                                            count_only=True))
+            assert not r.kvs and r.count == 3
+
+            # from-key convention: range_end = "\0" means every key >= key
+            r = await rng(m["RangeRequest"](key=b"k3", range_end=b"\x00"))
+            assert [kv.key for kv in r.kvs] == [b"k3", b"z"]
+
+            # delete with prev_kv
+            r = await dele(m["DeleteRangeRequest"](key=b"k1", prev_kv=True))
+            assert r.deleted == 1 and r.prev_kvs[0].value == b"vk1"
+            r = await rng(m["RangeRequest"](key=b"k1"))
+            assert not r.kvs
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_txn_and_compact():
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            txn = _mc(ch, m, "KV", "Txn", m["TxnRequest"], m["TxnResponse"])
+            compact = _mc(ch, m, "KV", "Compact",
+                          m["CompactionRequest"], m["CompactionResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+
+            await put(m["PutRequest"](key=b"cas", value=b"old"))
+
+            def cmp_value(key, val):
+                c = m["Compare"](key=key, value=val)
+                c.result = m["Compare"].CompareResult.EQUAL
+                c.target = m["Compare"].CompareTarget.VALUE
+                return c
+
+            # success branch: compare holds -> put new
+            req = m["TxnRequest"](
+                compare=[cmp_value(b"cas", b"old")],
+                success=[m["RequestOp"](
+                    request_put=m["PutRequest"](key=b"cas", value=b"new")
+                )],
+                failure=[m["RequestOp"](
+                    request_range=m["RangeRequest"](key=b"cas")
+                )],
+            )
+            r = await txn(req)
+            assert r.succeeded
+            assert r.responses[0].WhichOneof("response") == "response_put"
+            got = await rng(m["RangeRequest"](key=b"cas"))
+            assert got.kvs[0].value == b"new"
+
+            # failure branch: stale compare -> the range op runs instead
+            r = await txn(req)
+            assert not r.succeeded
+            assert r.responses[0].WhichOneof("response") == "response_range"
+            assert r.responses[0].response_range.kvs[0].value == b"new"
+
+            # compact at the current revision succeeds; future errors
+            await compact(m["CompactionRequest"](revision=r.header.revision))
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await compact(m["CompactionRequest"](revision=10_000))
+            assert e.value.code() == grpcio.StatusCode.OUT_OF_RANGE
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_range_sort_and_txn_range_semantics():
+    """The etcd behaviors a stock client leans on: descending limited
+    queries sort BEFORE limiting ('latest N'), from-key ranges work
+    inside Txn branches with one revision per DeleteRange, and range
+    compares (etcd >= 3.3) evaluate over the whole range."""
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+            txn = _mc(ch, m, "KV", "Txn", m["TxnRequest"], m["TxnResponse"])
+
+            for k in (b"a", b"b", b"c"):
+                await put(m["PutRequest"](key=k, value=b"v" + k))
+
+            # descending + limit: the LATEST page, not the oldest
+            r = await rng(m["RangeRequest"](
+                key=b"a", range_end=b"d", limit=1,
+                sort_order=m["RangeRequest"].SortOrder.DESCEND,
+            ))
+            assert [kv.key for kv in r.kvs] == [b"c"] and r.more
+
+            # sort by MOD descending = most recently written first
+            await put(m["PutRequest"](key=b"a", value=b"rewritten"))
+            r = await rng(m["RangeRequest"](
+                key=b"a", range_end=b"d", limit=1,
+                sort_order=m["RangeRequest"].SortOrder.DESCEND,
+                sort_target=m["RangeRequest"].SortTarget.MOD,
+            ))
+            assert [kv.key for kv in r.kvs] == [b"a"]
+
+            # keys_only holds on the from-key convention too
+            r = await rng(m["RangeRequest"](key=b"b", range_end=b"\x00",
+                                            keys_only=True))
+            assert [kv.key for kv in r.kvs] == [b"b", b"c"]
+            assert all(kv.value == b"" for kv in r.kvs)
+
+            # range compare: "no key in [x, y) exists" holds vacuously,
+            # fails once one exists
+            def no_key_in(key, range_end):
+                c = m["Compare"](key=key, range_end=range_end, version=0)
+                c.result = m["Compare"].CompareResult.EQUAL
+                c.target = m["Compare"].CompareTarget.VERSION
+                return c
+
+            req = m["TxnRequest"](
+                compare=[no_key_in(b"x", b"y")],
+                success=[m["RequestOp"](
+                    request_put=m["PutRequest"](key=b"x1", value=b"claimed")
+                )],
+            )
+            r = await txn(req)
+            assert r.succeeded  # empty range: vacuous
+            await put(m["PutRequest"](key=b"x2", value=b"taken"))
+            r = await txn(m["TxnRequest"](compare=[no_key_in(b"x", b"y")]))
+            assert not r.succeeded  # x1/x2 exist now
+
+            # from-key delete INSIDE a txn: works and is ONE revision
+            before = (await rng(m["RangeRequest"](key=b"a"))).header.revision
+            r = await txn(m["TxnRequest"](success=[m["RequestOp"](
+                request_delete_range=m["DeleteRangeRequest"](
+                    key=b"b", range_end=b"\x00"
+                )
+            )]))
+            assert r.succeeded
+            deleted = r.responses[0].response_delete_range.deleted
+            assert deleted >= 3  # b, c, x1, x2 minus whatever sorts below b
+            after = (await rng(m["RangeRequest"](key=b"a"))).header.revision
+            assert after == before + 1  # one revision for the whole range
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_keepalive_expired_lease_replies_ttl_minus_one():
+    """Real etcd answers keepalive for a gone lease with TTL=-1 on a LIVE
+    stream (a stream error would read as a retryable transport failure)."""
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            ka = ch.stream_stream(
+                "/etcdserverpb.Lease/LeaseKeepAlive",
+                request_serializer=m["LeaseKeepAliveRequest"].SerializeToString,
+                response_deserializer=m["LeaseKeepAliveResponse"].FromString,
+            )
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+            g = await grant(m["LeaseGrantRequest"](TTL=30))
+
+            # unknown lease then a live one, on ONE stream: -1 then 30
+            call = ka(iter([
+                m["LeaseKeepAliveRequest"](ID=999_999),
+                m["LeaseKeepAliveRequest"](ID=g.ID),
+            ]))
+            got = [r.TTL async for r in call]
+            assert got == [-1, 30]
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_lease_lifecycle():
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+            revoke = _mc(ch, m, "Lease", "LeaseRevoke",
+                         m["LeaseRevokeRequest"], m["LeaseRevokeResponse"])
+            ttl_q = _mc(ch, m, "Lease", "LeaseTimeToLive",
+                        m["LeaseTimeToLiveRequest"], m["LeaseTimeToLiveResponse"])
+            leases = _mc(ch, m, "Lease", "LeaseLeases",
+                         m["LeaseLeasesRequest"], m["LeaseLeasesResponse"])
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+
+            g = await grant(m["LeaseGrantRequest"](TTL=30))
+            lease_id = g.ID
+            assert lease_id > 0 and g.TTL == 30
+
+            await put(m["PutRequest"](key=b"ephemeral", value=b"x",
+                                      lease=lease_id))
+            t = await ttl_q(m["LeaseTimeToLiveRequest"](ID=lease_id, keys=True))
+            assert t.grantedTTL == 30 and list(t.keys) == [b"ephemeral"]
+
+            ls = await leases(m["LeaseLeasesRequest"]())
+            assert [s.ID for s in ls.leases] == [lease_id]
+
+            # bidi keepalive refreshes the TTL
+            ka = ch.stream_stream(
+                "/etcdserverpb.Lease/LeaseKeepAlive",
+                request_serializer=m["LeaseKeepAliveRequest"].SerializeToString,
+                response_deserializer=m["LeaseKeepAliveResponse"].FromString,
+            )
+            call = ka(iter([m["LeaseKeepAliveRequest"](ID=lease_id)]))
+            async for rsp in call:
+                assert rsp.ID == lease_id and rsp.TTL == 30
+                break
+
+            # revoke deletes attached keys
+            await revoke(m["LeaseRevokeRequest"](ID=lease_id))
+            r = await rng(m["RangeRequest"](key=b"ephemeral"))
+            assert not r.kvs
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await revoke(m["LeaseRevokeRequest"](ID=lease_id))
+            assert e.value.code() == grpcio.StatusCode.NOT_FOUND
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_lease_expires_on_wall_clock():
+    """The tick loop expires leases on real time: a TTL-1 lease's key is
+    gone within ~2.5 s (ref: the sim's per-second tick task,
+    service.rs:27-33, here on the wall clock)."""
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+
+            g = await grant(m["LeaseGrantRequest"](TTL=1))
+            await put(m["PutRequest"](key=b"evanescent", value=b"x", lease=g.ID))
+            assert (await rng(m["RangeRequest"](key=b"evanescent"))).kvs
+            await real.sleep(2.5)
+            assert not (await rng(m["RangeRequest"](key=b"evanescent"))).kvs
+        task.abort()
+
+    real.Runtime().block_on(main())

@@ -430,10 +430,13 @@ def test_group_auto_commit_on_unsubscribe():
 
 
 def test_group_rebalance_commits_consumed_before_revoke():
-    """A healthy rebalance must not re-deliver messages the application
-    already consumed: with auto-commit on (default), the member commits
-    consumed positions before adopting the new assignment, even though
-    the 5 s auto-commit interval never elapsed (commit-on-revoke)."""
+    """Commit-on-revoke: with auto-commit on (default), a member commits
+    consumed positions before adopting a new assignment, even though the
+    5 s auto-commit interval never elapsed — so a rebalance where the old
+    owner heartbeats before the new owner fetches re-delivers nothing.
+    (The window cannot be fully closed under the eager protocol: a new
+    member fetching BEFORE the old owner's next poll still re-delivers
+    the uncommitted tail — at-least-once, as in Kafka itself.)"""
 
     async def run():
         admin = await cfg().create(AdminClient)

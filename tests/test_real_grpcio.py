@@ -392,6 +392,79 @@ def test_stream_call_setup_failure_surfaces_at_await():
     real.Runtime().block_on(main())
 
 
+def test_wire_server_crash_mid_stream_then_recovery():
+    """The tonic-example server_crash scenario over genuine wire
+    (ref tonic-example/tests/test.rs:234-278): killing the server
+    mid-stream surfaces a transport-level Status on the client's next
+    read, calls to the dead address fail with UNAVAILABLE, and a
+    restarted server serves the same service class again."""
+    pkg = _pkg()
+    HelloRequest = pkg.messages["interopwire.HelloRequest"]
+    HelloReply = pkg.messages["interopwire.HelloReply"]
+
+    @pkg.implement("interopwire.Greeter")
+    class SlowGreeter:
+        async def say_hello(self, request):
+            return HelloReply(message=f"Hello {request.message.name}!")
+
+        async def lots_of_replies(self, request):
+            for i in range(100):
+                yield HelloReply(message=str(i))
+                await real.sleep(0.05)
+
+        async def lots_of_greetings(self, stream):
+            return HelloReply(message="n/a")
+
+        async def bidi_hello(self, stream):
+            if False:
+                yield
+
+    async def _serve():
+        router = grpc.GrpcioServer.builder().add_service(SlowGreeter())
+        task = real.spawn(router.serve(("127.0.0.1", 0)))
+        while router.bound_addr is None:
+            if task.done():
+                task.result()
+            await real.sleep(0.005)
+        host, port = router.bound_addr
+        return task, f"{host}:{port}"
+
+    async def main():
+        task, addr = await _serve()
+        channel = grpc.GrpcioChannel(addr)
+        client = grpc.GrpcioServiceClient(pkg.stub("interopwire.Greeter"), channel)
+
+        stream = await client.lots_of_replies(HelloRequest(name="s"))
+        first = await stream.message()
+        assert first.message == "0"
+        task.abort()  # kill the server mid-stream
+        await real.sleep(0.1)
+        with pytest.raises(grpc.Status):
+            while True:
+                m = await stream.message()
+                if m is None:  # a clean EOF would hide the crash
+                    raise AssertionError("stream ended cleanly past a crash")
+
+        # the dead address refuses further calls with a transport Status
+        with pytest.raises(grpc.Status) as e:
+            await client.say_hello(
+                grpc.Request(HelloRequest(name="x"), timeout=1.0)
+            )
+        assert e.value.code in (grpc.Code.UNAVAILABLE, grpc.Code.DEADLINE_EXCEEDED)
+        await channel.close()
+
+        # restart: the same service class serves again on a fresh port
+        task2, addr2 = await _serve()
+        channel2 = grpc.GrpcioChannel(addr2)
+        client2 = grpc.GrpcioServiceClient(pkg.stub("interopwire.Greeter"), channel2)
+        reply = await client2.say_hello(HelloRequest(name="back"))
+        assert reply.into_inner().message == "Hello back!"
+        await channel2.close()
+        task2.abort()
+
+    real.Runtime().block_on(main())
+
+
 def test_grpcio_tier_rejects_schemaless_services():
     """Hand-decorated @service classes carry no protobuf schema; the wire
     tier refuses them by name instead of failing downstream."""
