@@ -328,8 +328,7 @@ class EtcdService:
 
     def get(self, key: bytes, options: GetOptions) -> Tuple[int, List[KeyValue], int]:
         items = self._select(
-            key, options.prefix, options.range_end,
-            getattr(options, "from_key", False),
+            key, options.prefix, options.range_end, options.from_key
         )
         count = len(items)
         if options.limit:
@@ -346,8 +345,7 @@ class EtcdService:
 
     def delete(self, key: bytes, options: DeleteOptions) -> Tuple[int, int, List[KeyValue]]:
         items = self._select(
-            key, options.prefix, options.range_end,
-            getattr(options, "from_key", False),
+            key, options.prefix, options.range_end, options.from_key
         )
         if items:
             self.revision += 1

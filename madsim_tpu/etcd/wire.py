@@ -36,8 +36,6 @@ from .service import (
     GetOptions,
     KeyValue,
     PutOptions,
-    Txn,
-    TxnOp,
 )
 
 ETCD_PROTO = """
@@ -287,6 +285,18 @@ _SORT_KEYS = {
 
 
 def _range(m, svc: EtcdService, req):
+    from ..grpc.status import Status
+
+    if req.revision or req.min_mod_revision or req.max_mod_revision or (
+        req.min_create_revision or req.max_create_revision
+    ):
+        # the state machine keeps no MVCC history (current state only,
+        # like the reference sim) — fail loudly rather than hand back
+        # current data dressed up as a pinned-revision snapshot
+        raise Status.unimplemented(
+            "etcdserver: historical reads (revision / revision filters) "
+            "are not supported by this server; it keeps current state only"
+        )
     # fetch the FULL range, then sort -> limit -> count_only -> keys_only
     # in etcd's order (sorting after limiting would return the wrong page
     # for descending "latest N" queries)
@@ -299,8 +309,10 @@ def _range(m, svc: EtcdService, req):
         )
     if req.limit:
         items = items[: req.limit]
+    more = bool(req.limit) and count > len(items)
     if req.count_only:
         items = []
+        more = False  # etcd: count_only answers are never "truncated"
     if req.keys_only:
         items = [
             KeyValue(kv.key, b"", kv.create_revision, kv.mod_revision,
@@ -310,7 +322,7 @@ def _range(m, svc: EtcdService, req):
     return m["RangeResponse"](
         header=_header(m, svc),
         kvs=[_wire_kv(m, kv) for kv in items],
-        more=bool(req.limit) and count > len(items),
+        more=more,
         count=count,
     )
 
@@ -377,74 +389,42 @@ def _compare(req) -> Compare:
     )
 
 
-def _request_op(req) -> TxnOp:
-    which = req.WhichOneof("request")
-    if which == "request_put":
-        p = req.request_put
-        return TxnOp(
-            "put", (p.key, p.value, PutOptions(lease=p.lease, prev_kv=p.prev_kv))
-        )
-    if which == "request_range":
-        r = req.request_range
-        return TxnOp(
-            "get",
-            (r.key, _get_options(r.range_end, limit=r.limit,
-                                 count_only=r.count_only,
-                                 keys_only=r.keys_only)),
-        )
-    if which == "request_delete_range":
-        d = req.request_delete_range
-        return TxnOp("delete", (d.key, _delete_options(d.range_end, d.prev_kv)))
-    return TxnOp("txn", (_txn_from(req.request_txn),))
-
-
-def _txn_from(req) -> Txn:
-    return Txn(
-        compares=[_compare(c) for c in req.compare],
-        success=[_request_op(op) for op in req.success],
-        failure=[_request_op(op) for op in req.failure],
-    )
-
-
-def _txn_result_op(m, svc: EtcdService, result) -> "object":
-    kind, payload = result
-    op = m["ResponseOp"]()
-    if kind == "put":
-        _rev, prev = payload
-        rsp = m["PutResponse"](header=_header(m, svc))
-        if prev is not None:
-            rsp.prev_kv.CopyFrom(_wire_kv(m, prev))
-        op.response_put.CopyFrom(rsp)
-    elif kind == "get":
-        _rev, items, count = payload
-        op.response_range.CopyFrom(
-            m["RangeResponse"](
-                header=_header(m, svc),
-                kvs=[_wire_kv(m, kv) for kv in items],
-                count=count,
-            )
-        )
-    elif kind == "delete":
-        _rev, deleted, prevs = payload
-        op.response_delete_range.CopyFrom(
-            m["DeleteRangeResponse"](
-                header=_header(m, svc),
-                deleted=deleted,
-                prev_kvs=[_wire_kv(m, kv) for kv in prevs],
-            )
-        )
-    else:  # nested txn
-        op.response_txn.CopyFrom(_txn_response(m, svc, payload))
-    return op
-
-
-def _txn_response(m, svc: EtcdService, payload):
-    _rev, succeeded, results = payload
+def _run_txn(m, svc: EtcdService, req):
+    """Run a TxnRequest by routing each branch op through the SAME wire
+    handlers the top-level RPCs use — so sort/limit/more, the from-key
+    convention, keys_only, one-revision deletes, and the put guards hold
+    identically inside transactions. Atomicity is preserved: everything
+    below is synchronous single-threaded code, no awaits."""
+    succeeded = all(svc._check(_compare(c)) for c in req.compare)
     return m["TxnResponse"](
         header=_header(m, svc),
         succeeded=succeeded,
-        responses=[_txn_result_op(m, svc, r) for r in results],
+        responses=[
+            _apply_wire_op(m, svc, op)
+            for op in (req.success if succeeded else req.failure)
+        ],
     )
+
+
+def _apply_wire_op(m, svc: EtcdService, op):
+    from ..grpc.status import Status
+
+    which = op.WhichOneof("request")
+    rop = m["ResponseOp"]()
+    if which == "request_range":
+        rop.response_range.CopyFrom(_range(m, svc, op.request_range))
+    elif which == "request_put":
+        rop.response_put.CopyFrom(_put(m, svc, op.request_put))
+    elif which == "request_delete_range":
+        rop.response_delete_range.CopyFrom(
+            _delete(m, svc, op.request_delete_range)
+        )
+    elif which == "request_txn":
+        rop.response_txn.CopyFrom(_run_txn(m, svc, op.request_txn))
+    else:
+        # empty oneof: reject like etcd, don't run a vacuous nested txn
+        raise Status.invalid_argument("etcdserver: missing request op")
+    return rop
 
 
 def _make_services(pkg, svc: EtcdService):
@@ -463,7 +443,7 @@ def _make_services(pkg, svc: EtcdService):
             return _delete(m, svc, request.message)
 
         async def txn(self, request):
-            return _txn_response(m, svc, svc.txn(_txn_from(request.message)))
+            return _run_txn(m, svc, request.message)
 
         async def compact(self, request):
             svc.compact(request.message.revision)

@@ -202,6 +202,31 @@ def test_wire_range_sort_and_txn_range_semantics():
             r = await txn(m["TxnRequest"](compare=[no_key_in(b"x", b"y")]))
             assert not r.succeeded  # x1/x2 exist now
 
+            # txn ranges honor limit/more/sort exactly like the top level
+            r = await txn(m["TxnRequest"](success=[m["RequestOp"](
+                request_range=m["RangeRequest"](
+                    key=b"a", range_end=b"d", limit=1,
+                    sort_order=m["RangeRequest"].SortOrder.DESCEND,
+                )
+            )]))
+            nested = r.responses[0].response_range
+            assert [kv.key for kv in nested.kvs] == [b"c"] and nested.more
+
+            # an empty RequestOp is rejected, not run as a vacuous txn
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await txn(m["TxnRequest"](success=[m["RequestOp"]()]))
+            assert e.value.code() == grpcio.StatusCode.INVALID_ARGUMENT
+
+            # historical reads fail loudly (no MVCC history kept)
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await rng(m["RangeRequest"](key=b"a", revision=1))
+            assert e.value.code() == grpcio.StatusCode.UNIMPLEMENTED
+
+            # count_only is never "truncated"
+            r = await rng(m["RangeRequest"](key=b"a", range_end=b"d",
+                                            count_only=True, limit=1))
+            assert not r.kvs and not r.more and r.count == 3
+
             # from-key delete INSIDE a txn: works and is ONE revision
             before = (await rng(m["RangeRequest"](key=b"a"))).header.revision
             r = await txn(m["TxnRequest"](success=[m["RequestOp"](
