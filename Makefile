@@ -31,6 +31,7 @@ test-real:
 	$(PYTEST) tests/test_real.py tests/test_real_grpc.py \
 	  tests/test_real_grpcio.py tests/test_real_etcd.py \
 	  tests/test_real_kafka_s3.py tests/test_real_fs_signal.py \
+	  tests/test_etcd_wire.py tests/test_s3_wire.py \
 	  -q $(PYTEST_ARGS)
 
 test-procs:

@@ -16,9 +16,10 @@ public ``rpc.proto``/``kv.proto`` (field numbers and types must match for
 wire compatibility; message *names* need not — a peer never sees this
 descriptor). ``mvccpb.KeyValue`` is declared inside the ``etcdserverpb``
 package here because one .proto holds one package; the wire bytes are
-identical. Scope: the KV and Lease services (Watch's bidi create/cancel
-protocol and Maintenance are not exposed on the wire tier; the sim and
-framed-TCP tiers carry them).
+identical. Scope: the KV, Lease, and Watch services (Maintenance is not
+exposed on the wire tier; the sim and framed-TCP tiers carry it).
+Watches deliver current changes only — ``start_revision`` is answered
+with an immediate cancel naming the reason (no MVCC history is kept).
 """
 
 from __future__ import annotations
@@ -206,6 +207,48 @@ message LeaseLeasesResponse {
   repeated LeaseStatus leases = 2;
 }
 
+// mvccpb.Event, inlined like KeyValue
+message Event {
+  enum EventType { PUT = 0; DELETE = 1; }
+  EventType type = 1;
+  KeyValue kv = 2;
+  KeyValue prev_kv = 3;
+}
+
+message WatchCreateRequest {
+  enum FilterType { NOPUT = 0; NODELETE = 1; }
+  bytes key = 1;
+  bytes range_end = 2;
+  int64 start_revision = 3;
+  bool progress_notify = 4;
+  repeated FilterType filters = 5;
+  bool prev_kv = 6;
+  int64 watch_id = 7;
+  bool fragment = 8;
+}
+
+message WatchCancelRequest { int64 watch_id = 1; }
+message WatchProgressRequest {}
+
+message WatchRequest {
+  oneof request_union {
+    WatchCreateRequest create_request = 1;
+    WatchCancelRequest cancel_request = 2;
+    WatchProgressRequest progress_request = 3;
+  }
+}
+
+message WatchResponse {
+  ResponseHeader header = 1;
+  int64 watch_id = 2;
+  bool created = 3;
+  bool canceled = 4;
+  int64 compact_revision = 5;
+  string cancel_reason = 6;
+  bool fragment = 7;
+  repeated Event events = 11;
+}
+
 service KV {
   rpc Range (RangeRequest) returns (RangeResponse);
   rpc Put (PutRequest) returns (PutResponse);
@@ -222,6 +265,10 @@ service Lease {
   rpc LeaseTimeToLive (LeaseTimeToLiveRequest)
       returns (LeaseTimeToLiveResponse);
   rpc LeaseLeases (LeaseLeasesRequest) returns (LeaseLeasesResponse);
+}
+
+service Watch {
+  rpc Watch (stream WatchRequest) returns (stream WatchResponse);
 }
 """
 
@@ -389,12 +436,56 @@ def _compare(req) -> Compare:
     )
 
 
-def _run_txn(m, svc: EtcdService, req):
+def _validate_txn(svc: EtcdService, req) -> None:
+    """Reject an invalid TxnRequest BEFORE any op applies (etcd validates
+    the whole request first; raising mid-branch would leave earlier ops
+    committed behind an RPC error — a non-atomic txn on the wire). Covers
+    every error path the op handlers can raise: empty ops, unsupported
+    revision reads, put guards, oversized puts, and missing leases."""
+    from ..grpc.status import Status
+    from .service import MAX_REQUEST_SIZE
+
+    for op in list(req.success) + list(req.failure):
+        which = op.WhichOneof("request")
+        if which is None:
+            raise Status.invalid_argument("etcdserver: missing request op")
+        if which == "request_range":
+            r = op.request_range
+            if r.revision or r.min_mod_revision or r.max_mod_revision or (
+                r.min_create_revision or r.max_create_revision
+            ):
+                raise Status.unimplemented(
+                    "etcdserver: historical reads are not supported by "
+                    "this server; it keeps current state only"
+                )
+        elif which == "request_put":
+            p = op.request_put
+            if p.ignore_value or p.ignore_lease:
+                raise Status.unimplemented(
+                    "etcdserver: ignore_value/ignore_lease are not "
+                    "supported here"
+                )
+            if len(p.key) + len(p.value) > MAX_REQUEST_SIZE:
+                raise Status.invalid_argument(
+                    "etcdserver: request is too large"
+                )
+            if p.lease and p.lease not in svc.leases:
+                raise Status.not_found(
+                    "etcdserver: requested lease not found"
+                )
+        elif which == "request_txn":
+            _validate_txn(svc, op.request_txn)
+
+
+def _run_txn(m, svc: EtcdService, req, validated: bool = False):
     """Run a TxnRequest by routing each branch op through the SAME wire
     handlers the top-level RPCs use — so sort/limit/more, the from-key
     convention, keys_only, one-revision deletes, and the put guards hold
-    identically inside transactions. Atomicity is preserved: everything
-    below is synchronous single-threaded code, no awaits."""
+    identically inside transactions. Atomic: the whole request (both
+    branches, recursively) is validated before anything applies, and the
+    application itself is synchronous single-threaded code, no awaits."""
+    if not validated:
+        _validate_txn(svc, req)
     succeeded = all(svc._check(_compare(c)) for c in req.compare)
     return m["TxnResponse"](
         header=_header(m, svc),
@@ -420,9 +511,12 @@ def _apply_wire_op(m, svc: EtcdService, op):
             _delete(m, svc, op.request_delete_range)
         )
     elif which == "request_txn":
-        rop.response_txn.CopyFrom(_run_txn(m, svc, op.request_txn))
+        # already validated recursively by the outermost _run_txn
+        rop.response_txn.CopyFrom(
+            _run_txn(m, svc, op.request_txn, validated=True)
+        )
     else:
-        # empty oneof: reject like etcd, don't run a vacuous nested txn
+        # unreachable after _validate_txn, kept as a hard backstop
         raise Status.invalid_argument("etcdserver: missing request op")
     return rop
 
@@ -501,6 +595,127 @@ def _make_services(pkg, svc: EtcdService):
     return KVWire(), LeaseWire()
 
 
+def _make_watch_service(pkg, svc: EtcdService):
+    """The Watch bidi service: multiplexes create/cancel control messages
+    with event delivery on one response stream, as etcd does. Each watch
+    subscribes to the service EventBus (everything) and filters by its
+    own key range — range watches work even though the bus itself only
+    knows exact/prefix subscriptions."""
+    import asyncio
+
+    from .service import EventType
+
+    m = _mk_classes(pkg)
+
+    def _matches(create, key: bytes) -> bool:
+        if create.range_end == b"":
+            return key == create.key
+        if create.range_end == _FROM_END:
+            return key >= create.key
+        return create.key <= key < create.range_end
+
+    @pkg.implement("etcdserverpb.Watch")
+    class WatchWire:
+        async def watch(self, stream):
+            out: asyncio.Queue = asyncio.Queue()
+            pumps: dict = {}  # watch_id -> (bus watcher, pump task)
+            next_id = [1]
+            loop = asyncio.get_running_loop()
+
+            async def pump(wid: int, create, watcher) -> None:
+                nofilter = set(int(f) for f in create.filters)
+                while True:
+                    ev = await watcher.next()
+                    if not _matches(create, ev.kv.key):
+                        continue
+                    is_put = ev.type == EventType.PUT
+                    if (is_put and 0 in nofilter) or (
+                        not is_put and 1 in nofilter
+                    ):
+                        continue  # FilterType NOPUT=0 / NODELETE=1
+                    wev = m["Event"](
+                        type=(m["Event"].EventType.PUT if is_put
+                              else m["Event"].EventType.DELETE),
+                        kv=_wire_kv(m, ev.kv),
+                    )
+                    if create.prev_kv and ev.prev_kv is not None:
+                        wev.prev_kv.CopyFrom(_wire_kv(m, ev.prev_kv))
+                    await out.put(m["WatchResponse"](
+                        header=_header(m, svc), watch_id=wid, events=[wev]
+                    ))
+
+            async def reader() -> None:
+                try:
+                    async for req in stream:
+                        which = req.WhichOneof("request_union")
+                        if which == "create_request":
+                            c = req.create_request
+                            wid = c.watch_id or next_id[0]
+                            next_id[0] = max(next_id[0], wid) + 1
+                            if wid in pumps:
+                                # etcd rejects duplicate explicit ids; a
+                                # silent overwrite would leak the old bus
+                                # subscription and deliver events twice
+                                await out.put(m["WatchResponse"](
+                                    header=_header(m, svc), watch_id=wid,
+                                    canceled=True,
+                                    cancel_reason=(
+                                        "duplicated watch_id provided"
+                                    ),
+                                ))
+                                continue
+                            if c.start_revision:
+                                await out.put(m["WatchResponse"](
+                                    header=_header(m, svc), watch_id=wid,
+                                    created=True, canceled=True,
+                                    cancel_reason=(
+                                        "historical watch is not supported "
+                                        "by this server (no MVCC history)"
+                                    ),
+                                ))
+                                continue
+                            watcher = svc.bus.subscribe(b"", True)
+                            pumps[wid] = (
+                                watcher,
+                                loop.create_task(pump(wid, c, watcher)),
+                            )
+                            await out.put(m["WatchResponse"](
+                                header=_header(m, svc), watch_id=wid,
+                                created=True,
+                            ))
+                        elif which == "cancel_request":
+                            wid = req.cancel_request.watch_id
+                            entry = pumps.pop(wid, None)
+                            if entry is not None:
+                                entry[0].cancel()
+                                entry[1].cancel()
+                            await out.put(m["WatchResponse"](
+                                header=_header(m, svc), watch_id=wid,
+                                canceled=True,
+                            ))
+                        else:  # progress request
+                            await out.put(m["WatchResponse"](
+                                header=_header(m, svc), watch_id=-1
+                            ))
+                finally:
+                    await out.put(None)  # client closed its request side
+
+            rtask = loop.create_task(reader())
+            try:
+                while True:
+                    item = await out.get()
+                    if item is None:
+                        return
+                    yield item
+            finally:
+                rtask.cancel()
+                for watcher, task in pumps.values():
+                    watcher.cancel()
+                    task.cancel()
+
+    return WatchWire()
+
+
 class WireServer:
     """Serve an :class:`EtcdService` over genuine etcd v3 gRPC wire
     (real mode: grpc.aio transport + wall-clock lease ticks)."""
@@ -514,9 +729,20 @@ class WireServer:
         from ..real.grpc import GrpcioServer
         from ..real.runtime import spawn
 
+        import asyncio
+
+        # watchers block on asyncio futures here, not sim futures
+        self.service.bus.future_factory = (
+            lambda: asyncio.get_running_loop().create_future()
+        )
         pkg = wire_pkg()
         kv, lease = _make_services(pkg, self.service)
-        router = GrpcioServer.builder().add_service(kv).add_service(lease)
+        router = (
+            GrpcioServer.builder()
+            .add_service(kv)
+            .add_service(lease)
+            .add_service(_make_watch_service(pkg, self.service))
+        )
 
         async def tick_loop() -> None:
             while True:

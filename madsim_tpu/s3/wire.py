@@ -69,6 +69,24 @@ def _esc(s: str) -> str:
     )
 
 
+def _local(tag: str) -> str:
+    """Element local name: real S3 SDKs send namespaced bodies
+    (xmlns="http://s3.amazonaws.com/doc/2006-03-01/"), so every lookup
+    must match '{ns}Key' as well as bare 'Key'."""
+    return tag.rsplit("}", 1)[-1]
+
+
+def _elements(root: ET.Element, name: str):
+    return [el for el in root.iter() if _local(el.tag) == name]
+
+
+def _child_text(el: ET.Element, name: str, default: str = "") -> str:
+    for child in el:
+        if _local(child.tag) == name:
+            return child.text or default
+    return default
+
+
 class _Request:
     def __init__(self, method: str, path: str, query: Dict[str, str],
                  headers: Dict[str, str], body: bytes):
@@ -241,9 +259,7 @@ class WireServer:
         if req.method == "POST" and "delete" in req.query:
             root = ET.fromstring(req.body.decode())
             keys = [
-                el.findtext("Key", "")
-                for el in root.iter()
-                if el.tag.endswith("Object")
+                _child_text(el, "Key") for el in _elements(root, "Object")
             ]
             deleted = self.service.delete_objects(bucket, keys)
             inner = "".join(
@@ -298,9 +314,8 @@ class WireServer:
         if req.method == "POST" and "uploadId" in req.query:
             root = ET.fromstring(req.body.decode())
             part_numbers = [
-                int(el.findtext("PartNumber", "0"))
-                for el in root.iter()
-                if el.tag.endswith("Part")
+                int(_child_text(el, "PartNumber", "0"))
+                for el in _elements(root, "Part")
             ]
             etag = svc.complete_multipart_upload(
                 bucket, req.query["uploadId"], part_numbers, now_ms

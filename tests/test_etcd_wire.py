@@ -227,6 +227,23 @@ def test_wire_range_sort_and_txn_range_semantics():
                                             count_only=True, limit=1))
             assert not r.kvs and not r.more and r.count == 3
 
+            # atomicity: an invalid op ANYWHERE in the request rejects the
+            # whole txn BEFORE any op applies (earlier put must not leak)
+            before_rev = (await rng(m["RangeRequest"](key=b"a"))).header.revision
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await txn(m["TxnRequest"](success=[
+                    m["RequestOp"](request_put=m["PutRequest"](
+                        key=b"leak", value=b"x"
+                    )),
+                    m["RequestOp"](request_range=m["RangeRequest"](
+                        key=b"a", revision=1
+                    )),
+                ]))
+            assert e.value.code() == grpcio.StatusCode.UNIMPLEMENTED
+            r = await rng(m["RangeRequest"](key=b"leak"))
+            assert not r.kvs  # the put never applied
+            assert (await rng(m["RangeRequest"](key=b"a"))).header.revision == before_rev
+
             # from-key delete INSIDE a txn: works and is ONE revision
             before = (await rng(m["RangeRequest"](key=b"a"))).header.revision
             r = await txn(m["TxnRequest"](success=[m["RequestOp"](
@@ -320,6 +337,105 @@ def test_wire_lease_lifecycle():
             with pytest.raises(grpc_aio.AioRpcError) as e:
                 await revoke(m["LeaseRevokeRequest"](ID=lease_id))
             assert e.value.code() == grpcio.StatusCode.NOT_FOUND
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+def test_wire_watch_stream():
+    """The Watch bidi service over genuine wire: create a range watch,
+    observe PUT/DELETE events (with prev_kv) while unrelated keys are
+    filtered out, cancel it, and see historical watches refused by name."""
+    import asyncio
+
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            dele = _mc(ch, m, "KV", "DeleteRange",
+                       m["DeleteRangeRequest"], m["DeleteRangeResponse"])
+            watch = ch.stream_stream(
+                "/etcdserverpb.Watch/Watch",
+                request_serializer=m["WatchRequest"].SerializeToString,
+                response_deserializer=m["WatchResponse"].FromString,
+            )
+
+            req_q: asyncio.Queue = asyncio.Queue()
+
+            async def reqs():
+                while True:
+                    r = await req_q.get()
+                    if r is None:
+                        return
+                    yield r
+
+            call = watch(reqs())
+            it = call.__aiter__()
+
+            # create a [w, x) range watch with prev_kv
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](
+                    key=b"w", range_end=b"x", prev_kv=True
+                )
+            ))
+            r = await it.__anext__()
+            assert r.created and not r.canceled
+            wid = r.watch_id
+
+            # in-range put arrives; out-of-range key never does
+            await put(m["PutRequest"](key=b"zzz", value=b"ignored"))
+            await put(m["PutRequest"](key=b"w1", value=b"a"))
+            r = await it.__anext__()
+            ev = r.events[0]
+            assert r.watch_id == wid
+            assert ev.type == m["Event"].EventType.PUT
+            assert ev.kv.key == b"w1" and ev.kv.value == b"a"
+
+            # overwrite carries prev_kv; delete arrives as DELETE
+            await put(m["PutRequest"](key=b"w1", value=b"b"))
+            r = await it.__anext__()
+            assert r.events[0].kv.value == b"b"
+            assert r.events[0].prev_kv.value == b"a"
+            await dele(m["DeleteRangeRequest"](key=b"w1"))
+            r = await it.__anext__()
+            assert r.events[0].type == m["Event"].EventType.DELETE
+            assert r.events[0].kv.key == b"w1"
+
+            # cancel: acknowledged, then no more events for that watch
+            await req_q.put(m["WatchRequest"](
+                cancel_request=m["WatchCancelRequest"](watch_id=wid)
+            ))
+            r = await it.__anext__()
+            assert r.canceled and r.watch_id == wid
+
+            # historical watch refused by name (no MVCC history)
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](key=b"h",
+                                                       start_revision=1)
+            ))
+            r = await it.__anext__()
+            assert r.canceled and "historical" in r.cancel_reason
+
+            # duplicate explicit watch_id rejected, original still live
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](key=b"d",
+                                                       watch_id=77)
+            ))
+            r = await it.__anext__()
+            assert r.created and r.watch_id == 77
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](key=b"d",
+                                                       watch_id=77)
+            ))
+            r = await it.__anext__()
+            assert r.canceled and "duplicate" in r.cancel_reason
+            await put(m["PutRequest"](key=b"d", value=b"once"))
+            r = await it.__anext__()
+            assert r.watch_id == 77 and len(r.events) == 1  # delivered ONCE
+
+            await req_q.put(None)  # close our request side
         task.abort()
 
     real.Runtime().block_on(main())
