@@ -331,7 +331,10 @@ _SORT_KEYS = {
 }
 
 
-def _range(m, svc: EtcdService, req):
+def _guard_range(req) -> None:
+    """The one source of truth for unsupported RangeRequest shapes —
+    called by the top-level handler AND txn pre-validation, so the two
+    can never drift (drift would reintroduce non-atomic txns)."""
     from ..grpc.status import Status
 
     if req.revision or req.min_mod_revision or req.max_mod_revision or (
@@ -344,6 +347,27 @@ def _range(m, svc: EtcdService, req):
             "etcdserver: historical reads (revision / revision filters) "
             "are not supported by this server; it keeps current state only"
         )
+
+
+def _guard_put(svc: EtcdService, req) -> None:
+    """The one source of truth for PutRequest rejection (see
+    _guard_range); mirrors every raise path ``svc.put`` itself has so a
+    txn can validate before applying anything."""
+    from ..grpc.status import Status
+    from .service import MAX_REQUEST_SIZE
+
+    if req.ignore_value or req.ignore_lease:
+        raise Status.unimplemented(
+            "etcdserver: ignore_value/ignore_lease are not supported here"
+        )
+    if len(req.key) + len(req.value) > MAX_REQUEST_SIZE:
+        raise Status.invalid_argument("etcdserver: request is too large")
+    if req.lease and req.lease not in svc.leases:
+        raise Status.not_found("etcdserver: requested lease not found")
+
+
+def _range(m, svc: EtcdService, req):
+    _guard_range(req)
     # fetch the FULL range, then sort -> limit -> count_only -> keys_only
     # in etcd's order (sorting after limiting would return the wrong page
     # for descending "latest N" queries)
@@ -375,12 +399,7 @@ def _range(m, svc: EtcdService, req):
 
 
 def _put(m, svc: EtcdService, req):
-    from ..grpc.status import Status
-
-    if req.ignore_value or req.ignore_lease:
-        raise Status.unimplemented(
-            "etcdserver: ignore_value/ignore_lease are not supported here"
-        )
+    _guard_put(svc, req)
     opts = PutOptions(lease=req.lease, prev_kv=req.prev_kv)
     _rev, prev = svc.put(req.key, req.value, opts)
     out = m["PutResponse"](header=_header(m, svc))
@@ -443,36 +462,15 @@ def _validate_txn(svc: EtcdService, req) -> None:
     every error path the op handlers can raise: empty ops, unsupported
     revision reads, put guards, oversized puts, and missing leases."""
     from ..grpc.status import Status
-    from .service import MAX_REQUEST_SIZE
 
     for op in list(req.success) + list(req.failure):
         which = op.WhichOneof("request")
         if which is None:
             raise Status.invalid_argument("etcdserver: missing request op")
         if which == "request_range":
-            r = op.request_range
-            if r.revision or r.min_mod_revision or r.max_mod_revision or (
-                r.min_create_revision or r.max_create_revision
-            ):
-                raise Status.unimplemented(
-                    "etcdserver: historical reads are not supported by "
-                    "this server; it keeps current state only"
-                )
+            _guard_range(op.request_range)
         elif which == "request_put":
-            p = op.request_put
-            if p.ignore_value or p.ignore_lease:
-                raise Status.unimplemented(
-                    "etcdserver: ignore_value/ignore_lease are not "
-                    "supported here"
-                )
-            if len(p.key) + len(p.value) > MAX_REQUEST_SIZE:
-                raise Status.invalid_argument(
-                    "etcdserver: request is too large"
-                )
-            if p.lease and p.lease not in svc.leases:
-                raise Status.not_found(
-                    "etcdserver: requested lease not found"
-                )
+            _guard_put(svc, op.request_put)
         elif which == "request_txn":
             _validate_txn(svc, op.request_txn)
 
@@ -622,11 +620,17 @@ def _make_watch_service(pkg, svc: EtcdService):
             next_id = [1]
             loop = asyncio.get_running_loop()
 
-            async def pump(wid: int, create, watcher) -> None:
+            async def pump(wid: int, create, watcher,
+                           min_rev: int = 0) -> None:
                 nofilter = set(int(f) for f in create.filters)
                 while True:
                     ev = await watcher.next()
                     if not _matches(create, ev.kv.key):
+                        continue
+                    if min_rev and ev.kv.mod_revision < min_rev:
+                        # future start_revision: suppress events below it
+                        # (the read-then-watch-from-R+1 pattern expects
+                        # exactly the events at revision >= R+1)
                         continue
                     is_put = ev.type == EventType.PUT
                     if (is_put and 0 in nofilter) or (
@@ -664,7 +668,11 @@ def _make_watch_service(pkg, svc: EtcdService):
                                     ),
                                 ))
                                 continue
-                            if c.start_revision:
+                            if 0 < c.start_revision <= svc.revision:
+                                # past revisions need MVCC history we do
+                                # not keep; a FUTURE start_revision (the
+                                # canonical read-then-watch-from-R+1
+                                # pattern) needs none and is served below
                                 await out.put(m["WatchResponse"](
                                     header=_header(m, svc), watch_id=wid,
                                     created=True, canceled=True,
@@ -677,7 +685,10 @@ def _make_watch_service(pkg, svc: EtcdService):
                             watcher = svc.bus.subscribe(b"", True)
                             pumps[wid] = (
                                 watcher,
-                                loop.create_task(pump(wid, c, watcher)),
+                                loop.create_task(
+                                    pump(wid, c, watcher,
+                                         min_rev=c.start_revision)
+                                ),
                             )
                             await out.put(m["WatchResponse"](
                                 header=_header(m, svc), watch_id=wid,

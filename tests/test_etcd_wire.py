@@ -441,6 +441,66 @@ def test_wire_watch_stream():
     real.Runtime().block_on(main())
 
 
+def test_wire_watch_future_start_revision():
+    """The canonical read-then-watch pattern: Range gives revision R, the
+    client watches from start_revision=R+1 (servable without history —
+    only past revisions are refused), and events BELOW the start are
+    suppressed so the stream begins exactly where the read ended."""
+    import asyncio
+
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            rng = _mc(ch, m, "KV", "Range", m["RangeRequest"], m["RangeResponse"])
+            watch = ch.stream_stream(
+                "/etcdserverpb.Watch/Watch",
+                request_serializer=m["WatchRequest"].SerializeToString,
+                response_deserializer=m["WatchResponse"].FromString,
+            )
+            await put(m["PutRequest"](key=b"seen", value=b"already"))
+            rev = (await rng(m["RangeRequest"](key=b"seen"))).header.revision
+
+            req_q: asyncio.Queue = asyncio.Queue()
+
+            async def reqs():
+                while True:
+                    r = await req_q.get()
+                    if r is None:
+                        return
+                    yield r
+
+            it = watch(reqs()).__aiter__()
+            # watch from rev+3: the next TWO writes are below the start
+            # and must be suppressed; the third is the first delivered
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](
+                    key=b"s", range_end=b"t", start_revision=rev + 3
+                )
+            ))
+            r = await it.__anext__()
+            assert r.created and not r.canceled
+            await put(m["PutRequest"](key=b"s1", value=b"below1"))  # rev+1
+            await put(m["PutRequest"](key=b"s2", value=b"below2"))  # rev+2
+            await put(m["PutRequest"](key=b"s3", value=b"at-start"))  # rev+3
+            ev = (await it.__anext__()).events[0]
+            assert ev.kv.key == b"s3" and ev.kv.mod_revision == rev + 3
+
+            # a PAST start_revision is still refused by name
+            await req_q.put(m["WatchRequest"](
+                create_request=m["WatchCreateRequest"](key=b"s",
+                                                       start_revision=1)
+            ))
+            r = await it.__anext__()
+            assert r.canceled and "historical" in r.cancel_reason
+            await req_q.put(None)
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
 def test_wire_lease_expires_on_wall_clock():
     """The tick loop expires leases on real time: a TTL-1 lease's key is
     gone within ~2.5 s (ref: the sim's per-second tick task,
