@@ -18,8 +18,10 @@ descriptor). ``mvccpb.KeyValue`` is declared inside the ``etcdserverpb``
 package here because one .proto holds one package; the wire bytes are
 identical. Scope: the KV, Lease, and Watch services (Maintenance is not
 exposed on the wire tier; the sim and framed-TCP tiers carry it).
-Watches deliver current changes only — ``start_revision`` is answered
-with an immediate cancel naming the reason (no MVCC history is kept).
+Watches deliver current changes only: a FUTURE ``start_revision`` (the
+read-then-watch-from-R+1 pattern) is served, with events below the start
+suppressed; a PAST one — which would need MVCC history this server does
+not keep — is answered with an immediate cancel naming the reason.
 """
 
 from __future__ import annotations

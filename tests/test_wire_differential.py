@@ -40,6 +40,8 @@ def test_etcd_wire_differential_fuzz():
         server = etcd_wire.WireServer()
         task = real.spawn(server.serve(("127.0.0.1", 0)))
         while server.bound_addr is None:
+            if task.done():
+                task.result()  # surface bind failures instead of hanging
             await real.sleep(0.005)
         host, port = server.bound_addr
         m = {n.rsplit(".", 1)[-1]: c
@@ -130,6 +132,8 @@ def test_s3_wire_differential_fuzz():
         server = s3_wire.WireServer()
         task = real.spawn(server.serve(("127.0.0.1", 0)))
         while server.bound_addr is None:
+            if task.done():
+                task.result()  # surface bind failures instead of hanging
             await real.sleep(0.005)
         host, port = server.bound_addr
         base = f"http://{host}:{port}"
