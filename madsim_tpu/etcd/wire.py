@@ -16,8 +16,10 @@ public ``rpc.proto``/``kv.proto`` (field numbers and types must match for
 wire compatibility; message *names* need not — a peer never sees this
 descriptor). ``mvccpb.KeyValue`` is declared inside the ``etcdserverpb``
 package here because one .proto holds one package; the wire bytes are
-identical. Scope: the KV, Lease, and Watch services (Maintenance is not
-exposed on the wire tier; the sim and framed-TCP tiers carry it).
+identical. Scope: the KV, Lease, Watch, and Maintenance services
+(Status/Alarm/Defragment/Hash/Snapshot — the surface health tooling
+touches; the snapshot blob is this server's JSON dump, see
+``_make_maintenance_service``).
 Watches deliver current changes only: a FUTURE ``start_revision`` (the
 read-then-watch-from-R+1 pattern) is served, with events below the start
 suppressed; a PAST one — which would need MVCC history this server does
@@ -271,6 +273,60 @@ service Lease {
 
 service Watch {
   rpc Watch (stream WatchRequest) returns (stream WatchResponse);
+}
+
+message StatusRequest {}
+message StatusResponse {
+  ResponseHeader header = 1;
+  string version = 2;
+  int64 dbSize = 3;
+  uint64 leader = 4;
+  uint64 raftIndex = 5;
+  uint64 raftTerm = 6;
+  uint64 raftAppliedIndex = 7;
+  repeated string errors = 8;
+  int64 dbSizeInUse = 9;
+  bool isLearner = 10;
+}
+
+message AlarmRequest {
+  enum AlarmAction { GET = 0; ACTIVATE = 1; DEACTIVATE = 2; }
+  AlarmAction action = 1;
+  uint64 memberID = 2;
+  AlarmType alarm = 3;
+}
+enum AlarmType { NONE = 0; NOSPACE = 1; CORRUPT = 2; }
+message AlarmMember {
+  uint64 memberID = 1;
+  AlarmType alarm = 2;
+}
+message AlarmResponse {
+  ResponseHeader header = 1;
+  repeated AlarmMember alarms = 2;
+}
+
+message DefragmentRequest {}
+message DefragmentResponse { ResponseHeader header = 1; }
+
+message HashRequest {}
+message HashResponse {
+  ResponseHeader header = 1;
+  uint32 hash = 2;
+}
+
+message SnapshotRequest {}
+message SnapshotResponse {
+  ResponseHeader header = 1;
+  uint64 remaining_bytes = 2;
+  bytes blob = 3;
+}
+
+service Maintenance {
+  rpc Alarm (AlarmRequest) returns (AlarmResponse);
+  rpc Status (StatusRequest) returns (StatusResponse);
+  rpc Defragment (DefragmentRequest) returns (DefragmentResponse);
+  rpc Hash (HashRequest) returns (HashResponse);
+  rpc Snapshot (SnapshotRequest) returns (stream SnapshotResponse);
 }
 """
 
@@ -595,6 +651,76 @@ def _make_services(pkg, svc: EtcdService):
     return KVWire(), LeaseWire()
 
 
+def _make_maintenance_service(pkg, svc: EtcdService):
+    """The Maintenance surface health tooling touches (``etcdctl endpoint
+    status``, clientv3 health checks): Status, Alarm (always clear),
+    Defragment (a no-op on an in-memory store), Hash (over the state
+    dump), and Snapshot. The snapshot BLOB is this server's own JSON dump
+    (restorable via ``EtcdService.load``), not a bbolt database — the
+    stream protocol is etcd's, the payload format is declared here."""
+    import zlib
+
+    m = _mk_classes(pkg)
+
+    def _kv_hash() -> int:
+        """A function of KV state ONLY — the dump also carries live
+        leases' decaying ``remaining`` counters, which would make the
+        hash drift every wall-clock second and defeat its purpose
+        (comparing across calls/members to detect divergence)."""
+        acc = 0
+        for key in sorted(svc.kv):
+            kv = svc.kv[key]
+            acc = zlib.crc32(
+                b"%b\x00%b\x00%d\x00%d\x00%d\x00%d" % (
+                    kv.key, kv.value, kv.create_revision, kv.mod_revision,
+                    kv.version, kv.lease,
+                ),
+                acc,
+            )
+        return zlib.crc32(str(svc.revision).encode(), acc)
+
+    @pkg.implement("etcdserverpb.Maintenance")
+    class MaintenanceWire:
+        async def status(self, request):
+            dump = svc.dump().encode()
+            return m["StatusResponse"](
+                header=_header(m, svc),
+                version="3.5.0-madsim",
+                dbSize=len(dump),
+                dbSizeInUse=len(dump),
+                leader=1,
+                raftIndex=max(svc.revision, 1),
+                raftTerm=1,
+                raftAppliedIndex=max(svc.revision, 1),
+            )
+
+        async def alarm(self, request):
+            # an in-memory store never raises NOSPACE/CORRUPT; every
+            # action observes (and "clears") an empty alarm list
+            return m["AlarmResponse"](header=_header(m, svc), alarms=[])
+
+        async def defragment(self, request):
+            return m["DefragmentResponse"](header=_header(m, svc))
+
+        async def hash(self, request):
+            return m["HashResponse"](
+                header=_header(m, svc), hash=_kv_hash()
+            )
+
+        async def snapshot(self, request):
+            blob = svc.dump().encode()
+            chunk = 32 * 1024
+            for i in range(0, max(len(blob), 1), chunk):
+                part = blob[i:i + chunk]
+                yield m["SnapshotResponse"](
+                    header=_header(m, svc),
+                    remaining_bytes=max(0, len(blob) - (i + len(part))),
+                    blob=part,
+                )
+
+    return MaintenanceWire()
+
+
 def _make_watch_service(pkg, svc: EtcdService):
     """The Watch bidi service: multiplexes create/cancel control messages
     with event delivery on one response stream, as etcd does. Each watch
@@ -755,6 +881,7 @@ class WireServer:
             .add_service(kv)
             .add_service(lease)
             .add_service(_make_watch_service(pkg, self.service))
+            .add_service(_make_maintenance_service(pkg, self.service))
         )
 
         async def tick_loop() -> None:

@@ -72,6 +72,10 @@ class S3Service:
     def list_buckets(self) -> List[str]:
         return sorted(self.buckets)
 
+    def head_bucket(self, name: str) -> None:
+        """Existence probe (S3 HeadBucket); raises NoSuchBucket."""
+        self._bucket(name)
+
     # -- objects ------------------------------------------------------------
 
     def put_object(self, bucket: str, key: str, body: bytes, now_ms: int) -> str:

@@ -230,6 +230,10 @@ class WireServer:
         if req.method == "PUT":
             svc.create_bucket(bucket)
             return _Response(200)
+        if req.method == "HEAD":
+            # HeadBucket: SDKs probe bucket existence with it
+            svc.head_bucket(bucket)  # raises NoSuchBucket -> 404
+            return _Response(200)
         if req.method == "DELETE":
             svc.delete_bucket(bucket)
             return _Response(204)
@@ -272,13 +276,47 @@ class WireServer:
         svc = self.service
         now_ms = int(_walltime.time() * 1000)
         if req.method == "PUT" and "uploadId" in req.query:
+            if "x-amz-copy-source" in req.headers:
+                # UploadPartCopy: the part body comes from an existing
+                # object, answered with a CopyPartResult document
+                src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+                src_bucket, _, src_key = src.lstrip("/").partition("/")
+                body = svc.get_object(src_bucket, src_key).body
+            else:
+                body = req.body
             etag = svc.upload_part(
                 bucket,
                 req.query["uploadId"],
                 int(req.query.get("partNumber", "0")),
-                req.body,
+                body,
             )
+            if "x-amz-copy-source" in req.headers:
+                return _Response(
+                    200,
+                    _xml(
+                        "CopyPartResult",
+                        f"<ETag>{_esc(etag)}</ETag>"
+                        f"<LastModified>"
+                        f"{_esc(formatdate(now_ms / 1000, usegmt=True))}"
+                        f"</LastModified>",
+                    ),
+                )
             return _Response(200, headers={"ETag": etag})
+        if req.method == "PUT" and "x-amz-copy-source" in req.headers:
+            # CopyObject: source is "/bucket/key" (optionally URL-encoded)
+            src = urllib.parse.unquote(req.headers["x-amz-copy-source"])
+            src_bucket, _, src_key = src.lstrip("/").partition("/")
+            obj = svc.get_object(src_bucket, src_key)
+            etag = svc.put_object(bucket, key, obj.body, now_ms)
+            return _Response(
+                200,
+                _xml(
+                    "CopyObjectResult",
+                    f"<ETag>{_esc(etag)}</ETag>"
+                    f"<LastModified>{_esc(formatdate(now_ms / 1000, usegmt=True))}"
+                    f"</LastModified>",
+                ),
+            )
         if req.method == "PUT":
             etag = svc.put_object(bucket, key, req.body, now_ms)
             return _Response(200, headers={"ETag": etag})

@@ -501,6 +501,70 @@ def test_wire_watch_future_start_revision():
     real.Runtime().block_on(main())
 
 
+def test_wire_maintenance_surface():
+    """The Maintenance RPCs health tooling calls: Status (version/dbSize/
+    revision), Alarm (always clear), Defragment (no-op ack), Hash, and a
+    Snapshot stream whose reassembled blob restores the full state."""
+    m = _msgs()
+
+    async def main():
+        server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            put = _mc(ch, m, "KV", "Put", m["PutRequest"], m["PutResponse"])
+            status = _mc(ch, m, "Maintenance", "Status",
+                         m["StatusRequest"], m["StatusResponse"])
+            alarm = _mc(ch, m, "Maintenance", "Alarm",
+                        m["AlarmRequest"], m["AlarmResponse"])
+            defrag = _mc(ch, m, "Maintenance", "Defragment",
+                         m["DefragmentRequest"], m["DefragmentResponse"])
+            hash_mc = _mc(ch, m, "Maintenance", "Hash",
+                          m["HashRequest"], m["HashResponse"])
+
+            await put(m["PutRequest"](key=b"snap", value=b"state"))
+            s = await status(m["StatusRequest"]())
+            assert s.version and s.dbSize > 0
+            assert s.header.revision == 1
+
+            a = await alarm(m["AlarmRequest"]())
+            assert list(a.alarms) == []
+            assert (await defrag(m["DefragmentRequest"]())).header.revision == 1
+            # the hash is a function of KV state only: stable across
+            # wall-clock time even with a live (decaying) lease...
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+            await grant(m["LeaseGrantRequest"](TTL=60))
+            h1 = (await hash_mc(m["HashRequest"]())).hash
+            await real.sleep(1.2)  # the tick loop decays the lease
+            assert (await hash_mc(m["HashRequest"]())).hash == h1
+            # ...and changes when the KV store does
+            await put(m["PutRequest"](key=b"snap2", value=b"more"))
+            h2 = (await hash_mc(m["HashRequest"]())).hash
+            assert h1 != h2
+
+            # snapshot stream reassembles into a loadable dump
+            snap = ch.unary_stream(
+                "/etcdserverpb.Maintenance/Snapshot",
+                request_serializer=m["SnapshotRequest"].SerializeToString,
+                response_deserializer=m["SnapshotResponse"].FromString,
+            )
+            blob = b""
+            async for part in snap(m["SnapshotRequest"]()):
+                blob += part.blob
+                last_remaining = part.remaining_bytes
+            assert last_remaining == 0
+
+            from madsim_tpu.etcd.service import EtcdService
+
+            restored = EtcdService()
+            restored.load(blob.decode())
+            assert restored.kv[b"snap"].value == b"state"
+            assert restored.kv[b"snap2"].value == b"more"
+            assert restored.revision == 2
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
 def test_wire_lease_expires_on_wall_clock():
     """The tick loop expires leases on real time: a TTL-1 lease's key is
     gone within ~2.5 s (ref: the sim's per-second tick task,

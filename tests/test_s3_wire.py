@@ -115,6 +115,45 @@ def test_s3_wire_list_objects_v2_pagination():
     real.Runtime().block_on(main())
 
 
+def test_s3_wire_head_bucket_and_copy_object():
+    """HeadBucket (existence probe every SDK issues) and CopyObject via
+    the x-amz-copy-source header with its XML result."""
+    async def main():
+        server, task, base = await _start()
+        async with aiohttp.ClientSession() as http:
+            assert (await http.head(f"{base}/missing")).status == 404
+            await http.put(f"{base}/src")
+            assert (await http.head(f"{base}/src")).status == 200
+
+            r = await http.put(f"{base}/src/orig", data=b"copy me")
+            etag = r.headers["ETag"]
+
+            await http.put(f"{base}/dst")
+            r = await http.put(
+                f"{base}/dst/copied",
+                headers={"x-amz-copy-source": "/src/orig"},
+            )
+            assert r.status == 200
+            text = await r.text()
+            assert "<CopyObjectResult>" in text and etag in text
+
+            r = await http.get(f"{base}/dst/copied")
+            assert await r.read() == b"copy me"
+            assert r.headers["ETag"] == etag  # content-addressed
+
+            # missing source surfaces the S3 error
+            r = await http.put(
+                f"{base}/dst/bad",
+                headers={"x-amz-copy-source": "/src/nope"},
+            )
+            assert r.status == 404
+            assert "<Code>NoSuchKey</Code>" in await r.text()
+        server.close()
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
 def test_s3_wire_multipart_upload():
     async def main():
         server, task, base = await _start()
@@ -162,6 +201,31 @@ def test_s3_wire_multipart_upload():
             up2 = ET.fromstring(await r.text()).findtext("UploadId")
             r = await http.delete(f"{base}/mp/tmp.bin?uploadId={up2}")
             assert r.status == 204
+
+            # UploadPartCopy: a part sourced from an existing object
+            await http.put(f"{base}/mp/src.bin", data=b"SOURCE")
+            r = await http.post(f"{base}/mp/joined.bin?uploads")
+            up3 = ET.fromstring(await r.text()).findtext("UploadId")
+            r = await http.put(
+                f"{base}/mp/joined.bin?partNumber=1&uploadId={up3}",
+                headers={"x-amz-copy-source": "/mp/src.bin"},
+            )
+            assert r.status == 200
+            assert "<CopyPartResult>" in await r.text()
+            r = await http.put(
+                f"{base}/mp/joined.bin?partNumber=2&uploadId={up3}",
+                data=b"+TAIL",
+            )
+            doc2 = (
+                "<CompleteMultipartUpload>"
+                "<Part><PartNumber>1</PartNumber></Part>"
+                "<Part><PartNumber>2</PartNumber></Part>"
+                "</CompleteMultipartUpload>"
+            )
+            await http.post(f"{base}/mp/joined.bin?uploadId={up3}",
+                            data=doc2.encode())
+            r = await http.get(f"{base}/mp/joined.bin")
+            assert await r.read() == b"SOURCE+TAIL"
         server.close()
         task.abort()
 
