@@ -7,6 +7,8 @@
 #   make test-real     real-mode legs only (asyncio + real sockets + grpcio
 #                      wire + real fs/signal/process)
 #   make test-procs    forked-process sweep smoke (fail-fast, jax guard)
+#   make stest         sim suite + determinism smoke gate (a fault-campaign
+#                      sweep twice in two processes, traces byte-diffed)
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality
 #   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
@@ -19,10 +21,16 @@ PY ?= python
 PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
-.PHONY: test test-nonative test-real test-procs dryrun bench-smoke test-all
+.PHONY: test test-nonative test-real test-procs stest determinism dryrun \
+	bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
+
+determinism:
+	PY=$(PY) bash scripts/check_determinism.sh
+
+stest: test determinism
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

@@ -22,6 +22,7 @@ from typing import Dict, List
 sys.path.insert(0, __file__.rsplit("/", 2)[0])
 
 import madsim_tpu as ms
+from madsim_tpu import faults
 from madsim_tpu.net import Endpoint
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
@@ -156,56 +157,58 @@ def run_seed(
     return stats
 
 
-async def _supervise_plan(stats: Dict, n: int, plan, sim_seconds: float) -> None:
-    """Supervisor that applies a *recorded* fault plan (from a device-tier
-    trace, madsim_tpu/replay.py) instead of drawing its own faults.
-
-    Mismatched actions are skipped to match the device tier's semantics
-    exactly: restarting a live node is a no-op there (models/raft.py
-    ``_on_restart`` gates on ``was_dead``), while the host
-    ``Handle.restart`` would kill-and-respawn it — an extra fault the
-    recorded schedule never contained."""
+async def _supervise_plan(
+    stats: Dict, n: int, plan, sim_seconds: float, spec=None
+) -> None:
+    """Supervisor that applies a *recorded* fault schedule (from a
+    device-tier trace or ``faults.compile_host``) instead of drawing its
+    own faults — the shared ``madsim_tpu.faults.apply_schedule``
+    supervisor, which mirrors the device tier's edge-gated semantics
+    (restarting a live node is a no-op on both tiers)."""
     h = ms.current_handle()
     nodes: List = [
         h.create_node().name(f"raft-{i}").ip(_ip(i)).init(_node_init(i, n, stats)).build()
         for i in range(n)
     ]
-    dead = [False] * n
-    for t_ns, action, idx in plan:
-        dt = t_ns / 1e9 - ms.time.elapsed()
-        if dt > 0:
-            await ms.sleep(dt)
-        if action == "crash" and not dead[idx]:
-            h.kill(nodes[idx])
-            dead[idx] = True
-        elif action == "restart" and dead[idx]:
-            h.restart(nodes[idx])
-            dead[idx] = False
+    await faults.apply_schedule(plan, nodes, spec=spec)
     remaining = sim_seconds - ms.time.elapsed()
     if remaining > 0:
         await ms.sleep(remaining)
 
 
 def run_seed_with_plan(
-    seed: int, plan, n: int = 5, sim_seconds: float = 3.0
+    seed: int, plan, n: int = 5, sim_seconds: float = 3.0, spec=None
 ) -> Dict:
-    """One simulation with kills/restarts at the recorded virtual times.
+    """One simulation with the recorded faults at the recorded virtual
+    times.
 
     The cross-tier replay target: a device-found seed's fault schedule
     re-applied to this ordinary async implementation, debugger-attachable.
     The run always extends at least one second past the last planned
     fault so the cluster gets a post-fault observation window even when
-    the plan outlives ``sim_seconds``.
+    the plan outlives ``sim_seconds``. ``spec`` is only needed when the
+    schedule contains latency/loss burst events.
     """
     stats: Dict = {"elections": [], "violations": 0, "msgs": 0}
     end_s = sim_seconds
     if plan:
         end_s = max(end_s, max(t for t, _, _ in plan) / 1e9 + 1.0)
     rt = ms.Runtime(seed=seed)
-    rt.block_on(_supervise_plan(stats, n, plan, end_s))
+    rt.block_on(_supervise_plan(stats, n, plan, end_s, spec=spec))
     stats["seed"] = seed
     stats["leaders_elected"] = len(stats["elections"])
     return stats
+
+
+def run_seed_with_spec(
+    seed: int, spec, campaign_seed: int, n: int = 5, sim_seconds: float = 3.0
+) -> Dict:
+    """One simulation under a declarative fault campaign: the SAME
+    ``FaultSpec`` + ``campaign_seed`` a device-tier sweep lane compiles
+    (models/raft.py ``fault_spec``), applied to this ordinary async
+    implementation — no trace hop needed."""
+    plan = faults.compile_host(spec, n, campaign_seed)
+    return run_seed_with_plan(seed, plan, n=n, sim_seconds=sim_seconds, spec=spec)
 
 
 if __name__ == "__main__":

@@ -1,0 +1,400 @@
+"""Declarative fault campaigns: one ``FaultSpec``, two compilation targets.
+
+MadSim's value in the FoundationDB tradition is *systematic* fault
+injection — buggify points, clogs, kills (madsim/src/sim/net/mod.rs:163-284,
+task/mod.rs:347-394). Before this subsystem each device model hand-rolled
+its own crash/restart or partition plan in ``_init`` and the host tier
+relied on manual ``Handle.kill`` calls; now both tiers compile the SAME
+declarative spec:
+
+- ``FaultSpec`` is a pure NamedTuple (hashable — it rides inside model
+  configs, which are jit cache keys): crash/restart storms, partition/heal
+  cycles over a node group, network-wide latency-spike and message-loss
+  bursts, and node pause/resume windows.
+- ``schedule_events(spec, num_nodes, key)`` is THE schedule derivation —
+  seeded draws of fire times, durations and victims in a dedicated RNG
+  namespace (disjoint from every model's init/event streams). The device
+  tier evaluates it inside ``vmap``/``jit`` per seed; the host tier
+  (``madsim_tpu.faults.compile_host``) evaluates the identical function
+  eagerly for one seed, so the two tiers agree on the schedule *by
+  construction* — and ``tests/test_faults.py`` asserts it end-to-end
+  through the device engine's queue and dispatch machinery.
+- ``compile_device`` packs the schedule into a fault event stream
+  (``Emits``) any ``Workload`` splices into its initial event set; each
+  event's payload carries ``(action, victim, t_lo, t_hi)`` where
+  ``t = t_hi << 31 | t_lo`` is the exact scheduled deadline, so a traced
+  replay (``replay.extract_fault_schedule``) recovers the schedule
+  without the engine's dispatch jitter.
+- ``FaultState`` + ``on_event`` are the shared in-loop interpreter:
+  node-liveness/pause masks, per-victim partition refcounts, and
+  refcounted latency/loss overrides on ``engine.net.LinkState``. Models
+  keep only their *model-specific* crash/restart resets.
+
+Restore semantics: latency/loss bursts save nothing at runtime — the
+"off" transition restores the model's base values (``NetBase``, static
+python ints from the model config), so overlapping bursts compose via the
+refcount with no array state beyond two counters.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from . import net as enet
+from .core import Emits
+from .ops import get1, set1
+from .rng import bounded, prob_to_q32
+
+# fault action codes (payload slot 0 of a fault event)
+F_CRASH = 0
+F_RESTART = 1
+F_PART = 2
+F_HEAL = 3
+F_SPIKE_ON = 4
+F_SPIKE_OFF = 5
+F_LOSS_ON = 6
+F_LOSS_OFF = 7
+F_PAUSE = 8
+F_RESUME = 9
+
+#: action code -> stable wire name (used by the host supervisor + replay)
+ACTION_NAMES = (
+    "crash",
+    "restart",
+    "partition",
+    "heal",
+    "spike_on",
+    "spike_off",
+    "loss_on",
+    "loss_off",
+    "pause",
+    "resume",
+)
+
+# dedicated fold_in namespace for fault-schedule draws: disjoint from every
+# model's init namespace (0x7FFF_FFFF) and from per-event counters (< 2**31
+# in practice, but this constant is distinct regardless)
+FAULT_STREAM = 0x5EED_FA17 & 0x7FFF_FFFF
+
+Group = Tuple[int, int]  # victim range [lo, hi); hi = -1 means num_nodes
+
+
+class FaultSpec(NamedTuple):
+    """A declarative fault campaign (pure python ints/tuples — hashable,
+    reprs stably, rides inside model configs as part of the jit key).
+
+    Every category is a set of ``(start, end)`` windows: ``count`` pairs
+    whose start times are drawn uniformly in ``[0, window_ns)`` and whose
+    durations are drawn uniformly in ``[dur_lo_ns, dur_hi_ns)``. Victims
+    are drawn from the category's node group ``[lo, hi)`` (``hi = -1``
+    resolves to ``num_nodes`` at compile time)."""
+
+    # crash/restart storms (down-time = restart delay)
+    crashes: int = 0
+    crash_window_ns: int = 5_000_000_000
+    restart_lo_ns: int = 100_000_000
+    restart_hi_ns: int = 1_000_000_000
+    crash_group: Group = (0, -1)
+    # partition/heal cycles (clog both directions of the victim node)
+    partitions: int = 0
+    part_window_ns: int = 3_000_000_000
+    part_lo_ns: int = 500_000_000
+    part_hi_ns: int = 2_000_000_000
+    part_group: Group = (0, -1)
+    # network-wide latency-spike bursts (override the base latency range)
+    spikes: int = 0
+    spike_window_ns: int = 3_000_000_000
+    spike_dur_lo_ns: int = 200_000_000
+    spike_dur_hi_ns: int = 1_000_000_000
+    spike_lat_lo_ns: int = 1_000_000_000
+    spike_lat_hi_ns: int = 5_000_000_000
+    # network-wide message-loss bursts (override the base loss probability)
+    losses: int = 0
+    loss_window_ns: int = 3_000_000_000
+    loss_dur_lo_ns: int = 200_000_000
+    loss_dur_hi_ns: int = 1_000_000_000
+    burst_loss_q32: int = prob_to_q32(0.5)
+    # node pause/resume windows (clock-stop for the victim: no processing,
+    # no state loss; host tier = ``Handle.pause``/``resume``)
+    pauses: int = 0
+    pause_window_ns: int = 3_000_000_000
+    pause_lo_ns: int = 100_000_000
+    pause_hi_ns: int = 1_000_000_000
+    pause_group: Group = (0, -1)
+
+
+def num_events(spec: FaultSpec) -> int:
+    """Static event count of the compiled campaign (every category
+    contributes an on/off pair per window)."""
+    return 2 * (
+        spec.crashes + spec.partitions + spec.spikes + spec.losses + spec.pauses
+    )
+
+
+def _resolve_group(group: Group, num_nodes: int, what: str) -> Tuple[int, int]:
+    lo, hi = group
+    if hi < 0:
+        hi = num_nodes
+    if not 0 <= lo < hi <= num_nodes:
+        raise ValueError(
+            f"{what} group {group} does not resolve to a non-empty node "
+            f"range within [0, {num_nodes})"
+        )
+    return lo, hi
+
+
+def _categories(spec: FaultSpec, num_nodes: int):
+    """(count, on_action, off_action, window, dur_lo, dur_hi, vic_lo,
+    vic_hi) per category, in the fixed draw order."""
+    return (
+        (
+            spec.crashes, F_CRASH, F_RESTART, spec.crash_window_ns,
+            spec.restart_lo_ns, spec.restart_hi_ns,
+            *_resolve_group(spec.crash_group, num_nodes, "crash"),
+        ),
+        (
+            spec.partitions, F_PART, F_HEAL, spec.part_window_ns,
+            spec.part_lo_ns, spec.part_hi_ns,
+            *_resolve_group(spec.part_group, num_nodes, "partition"),
+        ),
+        (
+            spec.spikes, F_SPIKE_ON, F_SPIKE_OFF, spec.spike_window_ns,
+            spec.spike_dur_lo_ns, spec.spike_dur_hi_ns, 0, 1,
+        ),
+        (
+            spec.losses, F_LOSS_ON, F_LOSS_OFF, spec.loss_window_ns,
+            spec.loss_dur_lo_ns, spec.loss_dur_hi_ns, 0, 1,
+        ),
+        (
+            spec.pauses, F_PAUSE, F_RESUME, spec.pause_window_ns,
+            spec.pause_lo_ns, spec.pause_hi_ns,
+            *_resolve_group(spec.pause_group, num_nodes, "pause"),
+        ),
+    )
+
+
+def schedule_events(spec: FaultSpec, num_nodes: int, key: jax.Array):
+    """The shared schedule derivation: ``(times int64[E], actions int32[E],
+    victims int32[E])`` in pair order (NOT time-sorted — the device queue
+    orders by time at dispatch; the host supervisor sorts).
+
+    Draw layout: per window pair i (in category order) the draws are
+    ``rand[3i] = start``, ``rand[3i+1] = duration``, ``rand[3i+2] =
+    victim`` — a fixed layout so adding windows to one category never
+    shifts another category's draws within the pair sequence."""
+    e = num_events(spec)
+    if e == 0:
+        return (
+            jnp.zeros((0,), jnp.int64),
+            jnp.zeros((0,), jnp.int32),
+            jnp.zeros((0,), jnp.int32),
+        )
+    rand = jax.random.bits(
+        jax.random.fold_in(key, FAULT_STREAM), (3 * (e // 2),), dtype=jnp.uint32
+    )
+    times, actions, victims = [], [], []
+    i = 0
+    for count, a_on, a_off, window, dlo, dhi, vlo, vhi in _categories(
+        spec, num_nodes
+    ):
+        for _ in range(count):
+            t0 = bounded(rand[3 * i], 0, window)
+            dur = bounded(rand[3 * i + 1], dlo, dhi)
+            vic = bounded(rand[3 * i + 2], vlo, vhi).astype(jnp.int32)
+            times += [t0, t0 + dur]
+            actions += [jnp.asarray(a_on, jnp.int32), jnp.asarray(a_off, jnp.int32)]
+            victims += [vic, vic]
+            i += 1
+    return jnp.stack(times), jnp.stack(actions), jnp.stack(victims)
+
+
+def compile_device(
+    spec: FaultSpec,
+    num_nodes: int,
+    key: jax.Array,
+    fault_kind: int,
+    payload_slots: int,
+) -> Emits:
+    """Compile the campaign into a fault event stream a model splices into
+    its initial event set. Payload layout: ``(action, victim, t_lo, t_hi)``
+    with ``t = t_hi << 31 | t_lo`` the exact scheduled deadline (both
+    halves non-negative int32, so no sign-wrap ambiguity)."""
+    if payload_slots < 4:
+        raise ValueError(
+            f"fault events need 4 payload slots (action, victim, t_lo, "
+            f"t_hi); the workload has {payload_slots}"
+        )
+    times, actions, victims = schedule_events(spec, num_nodes, key)
+    e = int(times.shape[0])
+    pays = jnp.zeros((e, payload_slots), jnp.int32)
+    if e:
+        pays = pays.at[:, 0].set(actions)
+        pays = pays.at[:, 1].set(victims)
+        pays = pays.at[:, 2].set((times & 0x7FFF_FFFF).astype(jnp.int32))
+        pays = pays.at[:, 3].set((times >> 31).astype(jnp.int32))
+    return Emits(
+        times=times,
+        kinds=jnp.full((e,), fault_kind, jnp.int32),
+        pays=pays,
+        enables=jnp.ones((e,), bool),
+    )
+
+
+def decode_time(t_lo, t_hi):
+    """Recover the scheduled deadline from a fault event payload."""
+    return (jnp.asarray(t_hi, jnp.int64) << 31) | jnp.asarray(t_lo, jnp.int64)
+
+
+class NetBase(NamedTuple):
+    """The model's base network parameters (static python ints) — what a
+    burst's "off" transition restores, so no runtime save is needed."""
+
+    lat_lo_ns: int
+    lat_hi_ns: int
+    loss_q32: int
+
+
+class FaultState(NamedTuple):
+    """Per-seed interpreter state for the compiled campaign — the shared
+    piece of every model's workload state."""
+
+    alive: jnp.ndarray  # bool[N]
+    paused: jnp.ndarray  # bool[N]
+    part_cnt: jnp.ndarray  # int32[N] per-victim partition refcount
+    spike_cnt: jnp.ndarray  # int32 latency-burst refcount
+    loss_cnt: jnp.ndarray  # int32 loss-burst refcount
+
+
+class FaultEdges(NamedTuple):
+    """The transitions one fault event ACTUALLY caused, gated exactly the
+    way the host supervisor gates its ``Handle`` calls
+    (``faults.apply_schedule``): killing a dead node, restarting a live
+    one, and pausing/resuming a dead or already-paused/unpaused node are
+    all no-edges. Models key their model-specific consequences (state
+    wipes, timer-chain re-arms) off these booleans instead of re-deriving
+    them, so the host-mirror semantics stay single-sourced."""
+
+    crashed: jnp.ndarray  # bool: a live victim died
+    restarted: jnp.ndarray  # bool: a dead victim revived
+    paused: jnp.ndarray  # bool: a live, running victim paused
+    resumed: jnp.ndarray  # bool: a live, paused victim resumed
+
+
+def init_state(num_nodes: int) -> FaultState:
+    return FaultState(
+        alive=jnp.ones((num_nodes,), bool),
+        paused=jnp.zeros((num_nodes,), bool),
+        part_cnt=jnp.zeros((num_nodes,), jnp.int32),
+        spike_cnt=jnp.zeros((), jnp.int32),
+        loss_cnt=jnp.zeros((), jnp.int32),
+    )
+
+
+def up(f: FaultState) -> jnp.ndarray:
+    """bool[N]: node is processing events (alive and not paused)."""
+    return f.alive & ~f.paused
+
+
+def on_event(
+    spec: FaultSpec,
+    base: NetBase,
+    links: enet.LinkState,
+    f: FaultState,
+    action: jnp.ndarray,
+    victim: jnp.ndarray,
+):
+    """Apply one fault event to the shared state; returns ``(links,
+    fstate, edges)``. Model-specific consequences (wiping volatile state
+    on crash, re-arming timer chains on restart/resume) stay in the
+    model's fault handler, keyed off the returned ``FaultEdges``.
+
+    Partition and burst transitions are refcounted so overlapping windows
+    compose exactly: only the 0→1 edge applies and only the 1→0 edge
+    restores (same discipline the etcd model used for its private
+    partition plan)."""
+    is_crash = action == F_CRASH
+    is_restart = action == F_RESTART
+    is_part = action == F_PART
+    is_heal = action == F_HEAL
+    is_spike_on = action == F_SPIKE_ON
+    is_spike_off = action == F_SPIKE_OFF
+    is_loss_on = action == F_LOSS_ON
+    is_loss_off = action == F_LOSS_OFF
+    is_pause = action == F_PAUSE
+    is_resume = action == F_RESUME
+
+    was_alive = get1(f.alive, victim)
+    was_paused = get1(f.paused, victim)
+    edges = FaultEdges(
+        crashed=is_crash & was_alive,
+        restarted=is_restart & ~was_alive,
+        paused=is_pause & was_alive & ~was_paused,
+        resumed=is_resume & was_alive & was_paused,
+    )
+    alive = set1(f.alive, victim, False, is_crash)
+    alive = set1(alive, victim, True, is_restart)
+    # mirror the host supervisor exactly (faults.apply_schedule): a kill
+    # clears a pause (the node's tasks are gone — its restart revives it
+    # running), and pausing/resuming a dead node is a no-op
+    paused = set1(f.paused, victim, False, is_crash)
+    paused = set1(paused, victim, True, is_pause & was_alive)
+    paused = set1(paused, victim, False, is_resume & was_alive)
+
+    # partitions: refcounted node clog (ref NetSim::clog_node)
+    cnt = get1(f.part_cnt, victim)
+    clogged = enet.clog_node(links, victim)
+    links = jax.tree.map(
+        lambda a, b: jnp.where(is_part & (cnt == 0), a, b), clogged, links
+    )
+    unclogged = enet.unclog_node(links, victim)
+    links = jax.tree.map(
+        lambda a, b: jnp.where(is_heal & (cnt == 1), a, b), unclogged, links
+    )
+    part_cnt = set1(f.part_cnt, victim, cnt + 1, is_part)
+    part_cnt = set1(part_cnt, victim, jnp.maximum(cnt - 1, 0), is_heal)
+
+    # latency-spike bursts: override the whole link latency range
+    spike_apply = is_spike_on & (f.spike_cnt == 0)
+    spike_restore = is_spike_off & (f.spike_cnt == 1)
+    lat_lo = jnp.where(
+        spike_apply,
+        jnp.int64(spec.spike_lat_lo_ns),
+        jnp.where(spike_restore, jnp.int64(base.lat_lo_ns), links.lat_lo_ns),
+    )
+    lat_hi = jnp.where(
+        spike_apply,
+        jnp.int64(spec.spike_lat_hi_ns),
+        jnp.where(spike_restore, jnp.int64(base.lat_hi_ns), links.lat_hi_ns),
+    )
+    spike_cnt = jnp.where(
+        is_spike_on,
+        f.spike_cnt + 1,
+        jnp.where(is_spike_off, jnp.maximum(f.spike_cnt - 1, 0), f.spike_cnt),
+    )
+
+    # message-loss bursts: override the loss probability
+    loss_apply = is_loss_on & (f.loss_cnt == 0)
+    loss_restore = is_loss_off & (f.loss_cnt == 1)
+    loss_q32 = jnp.where(
+        loss_apply,
+        jnp.uint32(spec.burst_loss_q32),
+        jnp.where(loss_restore, jnp.uint32(base.loss_q32), links.loss_q32),
+    )
+    loss_cnt = jnp.where(
+        is_loss_on,
+        f.loss_cnt + 1,
+        jnp.where(is_loss_off, jnp.maximum(f.loss_cnt - 1, 0), f.loss_cnt),
+    )
+
+    links = links._replace(lat_lo_ns=lat_lo, lat_hi_ns=lat_hi, loss_q32=loss_q32)
+    f2 = FaultState(
+        alive=alive,
+        paused=paused,
+        part_cnt=part_cnt,
+        spike_cnt=spike_cnt,
+        loss_cnt=loss_cnt,
+    )
+    return links, f2, edges

@@ -41,9 +41,18 @@ def bounded(u32: jax.Array, low, high) -> jax.Array:
     Lemire-style multiply-shift reduction — same formula as the host tier's
     ``GlobalRng.gen_range`` so both tiers share bias characteristics.
     Result dtype is int64 (times are int64 ns).
+
+    The 96-bit product ``u32 * span`` is computed as two half-width
+    multiplies (the naive int64 product sign-wraps for spans above 2**31
+    ns ≈ 2.1 s — fault/command windows routinely exceed that). Bit-
+    identical to the single multiply wherever that didn't overflow; exact
+    for spans up to 2**47 (~39 hours in ns).
     """
     span = jnp.asarray(high, jnp.int64) - jnp.asarray(low, jnp.int64)
-    return jnp.asarray(low, jnp.int64) + (u32.astype(jnp.int64) * span >> 32)
+    hi = (u32 >> 16).astype(jnp.int64)
+    lo = (u32 & 0xFFFF).astype(jnp.int64)
+    carry = (lo * span) >> 16
+    return jnp.asarray(low, jnp.int64) + ((hi * span + carry) >> 16)
 
 
 def coin(u32: jax.Array, prob_q32: jax.Array) -> jax.Array:

@@ -263,9 +263,25 @@ class Broker:
         return g.generation, g.assignments.get(member_id, [])
 
     def commit_offsets(
-        self, group_id: str, offsets: List[Tuple[str, int, int]]
+        self,
+        group_id: str,
+        offsets: List[Tuple[str, int, int]],
+        generation: Optional[int] = None,
     ) -> None:
+        """Commit offsets, fenced by generation: a commit stamped with a
+        generation below the group's current one is a zombie — a member
+        still acting on an assignment a later rebalance revoked — and is
+        rejected (real Kafka's ILLEGAL_GENERATION), because applying it
+        could roll a partition's committed offset backward past the new
+        owner's commits. ``generation=None`` (legacy callers, simple
+        tooling) skips the fence."""
         g = self._group_lookup(group_id)
+        if generation is not None and generation < g.generation:
+            raise KafkaBrokerError(
+                f"ILLEGAL_GENERATION: commit for group {group_id!r} carries "
+                f"generation {generation} < current {g.generation} (zombie "
+                "member — rejoin before committing)"
+            )
         for topic, partition, offset in offsets:
             self._partition(topic, partition)  # validate
             g.committed[(topic, partition)] = offset

@@ -271,8 +271,28 @@ class BaseConsumer:
             ("heartbeat", self._group, self._member)
         )
         if gen != self._generation:
-            if self._auto_commit and self._generation >= 0:
-                await self.commit()
+            had_generation = self._generation >= 0
+            # adopt the observed generation, then commit ONLY the
+            # positions this member retains under the new assignment.
+            # Committing a revoked partition here could roll the group's
+            # offset backward past the new owner's progress — the exact
+            # rollback the broker's generation fence exists to stop; a
+            # member that merely heard the new generation number must not
+            # launder stale positions through it. The revoked tail is
+            # redelivered to the new owner: the eager protocol's
+            # at-least-once window, as in Kafka itself.
+            self._generation = gen
+            if self._auto_commit and had_generation:
+                keep = {tuple(tp) for tp in assigned}
+                offsets = [
+                    (a.topic, a.partition, a.consumed)
+                    for a in self._assignments
+                    if (a.topic, a.partition) in keep
+                ]
+                if offsets:
+                    await self._conn.call(
+                        ("commit", self._group, offsets, gen)
+                    )
             await self._apply_assignment(gen, assigned)
 
     async def commit(self) -> None:
@@ -282,7 +302,8 @@ class BaseConsumer:
             return
         await self._conn.call(
             ("commit", self._group,
-             [(a.topic, a.partition, a.consumed) for a in self._assignments])
+             [(a.topic, a.partition, a.consumed) for a in self._assignments],
+             self._generation)
         )
 
     async def committed(self, tpl: "TopicPartitionList") -> List[Tuple[str, int, Optional[int]]]:

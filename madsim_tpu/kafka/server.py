@@ -93,8 +93,9 @@ class SimBroker:
             _, group, member = req
             return b.group_state(group, member)
         if op == "commit":
-            _, group, offsets = req
-            b.commit_offsets(group, offsets)
+            # legacy 3-tuple requests carry no generation (fence skipped)
+            _, group, offsets = req[:3]
+            b.commit_offsets(group, offsets, req[3] if len(req) > 3 else None)
             return None
         if op == "committed":
             _, group, tps = req

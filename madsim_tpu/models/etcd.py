@@ -37,21 +37,24 @@ Design notes:
   pattern as models/raft.py timer chains).
 - A keepalive for a lease that is not live (re)grants it — clients own a
   fixed lease slot and heartbeat it, the etcd-session usage pattern.
-- Partition windows are refcounted per victim (``part_cnt``), so
-  overlapping windows of the same client compose exactly. Overlapping
-  windows of *different* clients can still unclog each other's two shared
-  link cells early (clog_node sets whole rows/cols); the fault pattern is
-  slightly weaker in that corner, determinism is unaffected.
+- Partition windows come from the shared fault compiler
+  (``engine/faults.py``) and are refcounted per victim
+  (``FaultState.part_cnt``), so overlapping windows of the same client
+  compose exactly. Overlapping windows of *different* clients can still
+  unclog each other's two shared link cells early (clog_node sets whole
+  rows/cols); the fault pattern is slightly weaker in that corner,
+  determinism is unaffected.
 """
 
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..engine import faults as efaults
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, set1
@@ -64,8 +67,7 @@ K_OP = 0  # pay = (client,) — client op timer: send a PUT or GET
 K_KEEPALIVE = 1  # pay = (client,) — client lease-heartbeat timer
 K_MSG = 2  # pay = (dst, mtype, src, a, b, c)
 K_EXPIRE = 3  # pay = (lease, gen) — server lease-expiry deadline
-K_PART = 4  # pay = (victim,) — clog a client node
-K_HEAL = 5  # pay = (victim,)
+K_FAULT = 4  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
 
 # message types
 MT_LEASE = 0  # grant-or-keepalive; a = lease id
@@ -92,7 +94,8 @@ class EtcdConfig(NamedTuple):
     keepalive_hi_ns: int = 400_000_000
     op_lo_ns: int = 50_000_000
     op_hi_ns: int = 150_000_000
-    # partition plan: windows clogging one client in the first part of the run
+    # legacy client-partition shorthand, compiled through engine/faults.py;
+    # `faults` (below) overrides all four when set
     partitions: int = 2
     part_window_ns: int = 3_000_000_000
     part_lo_ns: int = 500_000_000
@@ -107,10 +110,27 @@ class EtcdConfig(NamedTuple):
     # deliberate bugs for checker validation
     bug_skip_expiry: bool = False  # expiry handler does nothing
     bug_rev_regress: bool = False  # expiry decrements the revision
+    # full declarative fault campaign (engine/faults.FaultSpec); None =
+    # derive a client-partition spec from the legacy fields above
+    faults: Optional[efaults.FaultSpec] = None
 
     @property
     def num_nodes(self) -> int:
         return 1 + self.num_clients
+
+
+def fault_spec(cfg: EtcdConfig) -> efaults.FaultSpec:
+    """``cfg.faults`` verbatim, or the legacy partition fields lifted into
+    a FaultSpec whose partition group is the client nodes (1..N)."""
+    if cfg.faults is not None:
+        return cfg.faults
+    return efaults.FaultSpec(
+        partitions=cfg.partitions,
+        part_window_ns=cfg.part_window_ns,
+        part_lo_ns=cfg.part_lo_ns,
+        part_hi_ns=cfg.part_hi_ns,
+        part_group=(1, -1),
+    )
 
 
 class EtcdState(NamedTuple):
@@ -129,8 +149,8 @@ class EtcdState(NamedTuple):
     # clients [NC]
     seen_rev: jnp.ndarray  # int32 revision of the newest-sequenced reply
     seen_seq: jnp.ndarray  # int32 sequence number of that reply
-    # partition refcount [NC]: a client may sit in overlapping windows
-    part_cnt: jnp.ndarray  # int32
+    # shared liveness/pause/partition/burst state [num_nodes]
+    fstate: efaults.FaultState
     # network
     links: enet.LinkState
     # sweep outputs
@@ -167,9 +187,12 @@ def _client_node(c):
 
 def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     """Client c sends a PUT (own key, lease-attached; or a shared key,
-    no lease) or a GET of a random key, then re-arms."""
+    no lease) or a GET of a random key, then re-arms. A crashed/paused
+    client's timer keeps ticking but sends nothing (the kafka model's
+    timer idiom — host tier: the killed node's tasks are gone)."""
     c = pay[0]
     node = _client_node(c)
+    can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
     kind_draw = rand[2]
     key_draw = bounded(rand[3], 0, cfg.num_keys).astype(jnp.int32)
@@ -187,36 +210,40 @@ def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     )
     interval = bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns)
     emits = _emits2(
-        (t, K_MSG, msg, deliver),
+        (t, K_MSG, msg, can_send & deliver),
         (now + interval, K_OP, _pay(c), True),
     )
     w2 = w._replace(
-        msgs_sent=w.msgs_sent + 1,
-        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(can_send, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(can_send & deliver, 1, 0),
     )
     return w2, emits
 
 
 def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
-    """Client c heartbeats its lease and re-arms."""
+    """Client c heartbeats its lease and re-arms; a crashed/paused
+    client sends nothing, so its lease genuinely expires — the checker
+    coverage client death exists to exercise."""
     c = pay[0]
     node = _client_node(c)
+    can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
     interval = bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns)
     emits = _emits2(
-        (t, K_MSG, _pay(SERVER, MT_LEASE, node, c), deliver),
+        (t, K_MSG, _pay(SERVER, MT_LEASE, node, c), can_send & deliver),
         (now + interval, K_KEEPALIVE, _pay(c), True),
     )
     w2 = w._replace(
-        msgs_sent=w.msgs_sent + 1,
-        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(can_send, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(can_send & deliver, 1, 0),
     )
     return w2, emits
 
 
 def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     dst, mtype, src, a, b, c_ = pay[0], pay[1], pay[2], pay[3], pay[4], pay[5]
-    at_server = dst == SERVER
+    up = efaults.up(w.fstate)
+    at_server = (dst == SERVER) & get1(up, SERVER)
 
     # -- server: LEASE (grant-or-keepalive) — reset the countdown, bump the
     # generation, schedule a fresh expiry deadline (service.rs keepalive +
@@ -265,7 +292,7 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     # -- client: RSP — revision monotonicity, checked in server-send
     # order (replies reordered by the network are stale and skipped, as a
     # real client reading one ordered gRPC stream would never see them)
-    is_rsp = (mtype == MT_RSP) & (dst >= 1)
+    is_rsp = (mtype == MT_RSP) & (dst >= 1) & get1(up, dst)
     client = dst - 1
     newer = is_rsp & (b > get1(w.seen_seq, client))
     regress = newer & (a < get1(w.seen_rev, client))
@@ -335,44 +362,26 @@ def _on_expire(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     return w2, _emits2(None, None)
 
 
-def _on_part(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
-    """Clog the victim's links; refcounted so overlapping windows of the
-    same victim compose (the heal of the first window must not reopen the
-    second's)."""
-    c = pay[0]
-    victim = _client_node(c)
-    cnt = get1(w.part_cnt, c)
-    links2 = jax.tree.map(
-        lambda a, b: jnp.where(cnt == 0, a, b),
-        enet.clog_node(w.links, victim),
-        w.links,
+def _on_fault(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
+    """One event of the compiled fault campaign (engine/faults.py): the
+    shared interpreter handles the refcounted clog/heal (overlapping
+    windows of the same victim compose — the heal of the first window
+    must not reopen the second's), liveness/pause masks, and latency/loss
+    bursts. This model has no per-node volatile state to reset: faults
+    here act on connectivity and processing gates only (the server's KV
+    store is durable; lease expiry deadlines keep running through a
+    server crash/pause window)."""
+    action, victim = pay[0], pay[1]
+    base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
+    links2, f2, _edges = efaults.on_event(
+        fault_spec(cfg), base, w.links, w.fstate, action, victim
     )
-    return (
-        w._replace(
-            links=links2,
-            part_cnt=set1(w.part_cnt, c, cnt + 1),
-            parts=w.parts + 1,
-        ),
-        _emits2(None, None),
+    w2 = w._replace(
+        links=links2,
+        fstate=f2,
+        parts=w.parts + jnp.where(action == efaults.F_PART, 1, 0),
     )
-
-
-def _on_heal(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
-    c = pay[0]
-    victim = _client_node(c)
-    cnt = get1(w.part_cnt, c)
-    links2 = jax.tree.map(
-        lambda a, b: jnp.where(cnt == 1, a, b),
-        enet.unclog_node(w.links, victim),
-        w.links,
-    )
-    return (
-        w._replace(
-            links=links2,
-            part_cnt=set1(w.part_cnt, c, jnp.maximum(cnt - 1, 0)),
-        ),
-        _emits2(None, None),
-    )
+    return w2, _emits2(None, None)
 
 
 def _handle(cfg: EtcdConfig, w: EtcdState, now, kind, pay, rand):
@@ -381,8 +390,7 @@ def _handle(cfg: EtcdConfig, w: EtcdState, now, kind, pay, rand):
         partial(_on_keepalive_timer, cfg),
         partial(_on_msg, cfg),
         partial(_on_expire, cfg),
-        partial(_on_part, cfg),
-        partial(_on_heal, cfg),
+        partial(_on_fault, cfg),
     ]
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
@@ -391,11 +399,9 @@ def _init(cfg: EtcdConfig, key):
     nc = cfg.num_clients
     if cfg.num_keys < nc:
         raise ValueError("num_keys must cover one lease key per client")
-    ninit = 2 * nc + 2 * cfg.partitions
+    ninit = 2 * nc
     rand = jax.random.bits(
-        jax.random.fold_in(key, 0x7FFF_FFFF),
-        (ninit + cfg.partitions,),
-        dtype=jnp.uint32,
+        jax.random.fold_in(key, 0x7FFF_FFFF), (ninit,), dtype=jnp.uint32
     )
     w = EtcdState(
         kv_present=jnp.zeros((cfg.num_keys,), bool),
@@ -409,7 +415,7 @@ def _init(cfg: EtcdConfig, key):
         rsp_seq=jnp.zeros((nc,), jnp.int32),
         seen_rev=jnp.zeros((nc,), jnp.int32),
         seen_seq=jnp.zeros((nc,), jnp.int32),
-        part_cnt=jnp.zeros((nc,), jnp.int32),
+        fstate=efaults.init_state(cfg.num_nodes),
         links=enet.make(
             cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
             cfg.buggify_q32,
@@ -441,18 +447,16 @@ def _init(cfg: EtcdConfig, key):
         )
         kinds = kinds.at[2 * c + 1].set(K_OP)
         pays = pays.at[2 * c + 1].set(_pay(c))
-    base = 2 * nc
-    for p in range(cfg.partitions):
-        t_part = bounded(rand[base + 2 * p], 0, cfg.part_window_ns)
-        dur = bounded(rand[base + 2 * p + 1], cfg.part_lo_ns, cfg.part_hi_ns)
-        victim = bounded(rand[ninit + p], 0, nc).astype(jnp.int32)
-        times = times.at[base + 2 * p].set(t_part)
-        kinds = kinds.at[base + 2 * p].set(K_PART)
-        pays = pays.at[base + 2 * p].set(_pay(victim))
-        times = times.at[base + 2 * p + 1].set(t_part + dur)
-        kinds = kinds.at[base + 2 * p + 1].set(K_HEAL)
-        pays = pays.at[base + 2 * p + 1].set(_pay(victim))
-    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+    # fault campaign: the shared compiler's event stream, spliced in
+    fe = efaults.compile_device(
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+    )
+    return w, Emits(
+        times=jnp.concatenate([times, fe.times]),
+        kinds=jnp.concatenate([kinds, fe.kinds]),
+        pays=jnp.concatenate([pays, fe.pays]),
+        enables=jnp.concatenate([enables, fe.enables]),
+    )
 
 
 @_common.memoized_workload(EtcdConfig)
@@ -478,7 +482,9 @@ def engine_config(cfg: EtcdConfig = EtcdConfig(), **overrides) -> EngineConfig:
     defaults = dict(
         queue_capacity=max(
             48,
-            cfg.num_clients * (4 + stale_expiries) + 2 * cfg.partitions + 8,
+            cfg.num_clients * (4 + stale_expiries)
+            + efaults.num_events(fault_spec(cfg))
+            + 8,
         ),
         time_limit_ns=5_000_000_000,
         max_steps=200_000,

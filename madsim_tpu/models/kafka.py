@@ -43,11 +43,12 @@ Design notes (shared with models/raft.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..engine import faults as efaults
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, set1, set2
@@ -59,8 +60,7 @@ K_PRODUCE = 0  # pay = (producer,) — producer timer: send next unacked seq
 K_FETCH = 1  # pay = (consumer,) — consumer timer: poll from current offset
 K_MSG = 2  # pay = (dst_node, mtype, src_node, a, b, c)
 K_FLUSH = 3  # pay = (bgen,) — broker durability timer
-K_CRASH = 4  # broker crash (fault plan)
-K_RESTART = 5  # broker restart
+K_FAULT = 4  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
 
 # message types (pay slots a/b/c per type)
 MT_PRODUCE = 0  # a = seq
@@ -89,7 +89,8 @@ class KafkaConfig(NamedTuple):
     fetch_max: int = 4  # records per fetch response
     # broker durability cadence (flush marks the log durable)
     flush_interval_ns: int = 200_000_000
-    # fault plan: broker crash/restart events in the first crash_window_ns
+    # legacy broker-crash shorthand, compiled through engine/faults.py;
+    # `faults` (below) overrides all four when set
     crashes: int = 1
     crash_window_ns: int = 3_000_000_000
     restart_lo_ns: int = 100_000_000
@@ -102,15 +103,32 @@ class KafkaConfig(NamedTuple):
     # deliberate bug for checker validation: ack on append instead of at
     # flush — crash between append and flush loses acknowledged messages
     bug_ack_on_append: bool = False
+    # full declarative fault campaign (engine/faults.FaultSpec); None =
+    # derive a broker-crash spec from the legacy fields above
+    faults: Optional[efaults.FaultSpec] = None
 
     @property
     def num_nodes(self) -> int:
         return 1 + self.num_producers + self.num_consumers
 
 
+def fault_spec(cfg: KafkaConfig) -> efaults.FaultSpec:
+    """``cfg.faults`` verbatim, or the legacy broker-crash fields lifted
+    into a FaultSpec targeting the broker node only."""
+    if cfg.faults is not None:
+        return cfg.faults
+    return efaults.FaultSpec(
+        crashes=cfg.crashes,
+        crash_window_ns=cfg.crash_window_ns,
+        restart_lo_ns=cfg.restart_lo_ns,
+        restart_hi_ns=cfg.restart_hi_ns,
+        crash_group=(BROKER, BROKER + 1),
+    )
+
+
 class KafkaState(NamedTuple):
-    # broker
-    alive: jnp.ndarray  # bool
+    # shared liveness/pause/partition/burst state (broker is node 0)
+    fstate: efaults.FaultState
     bgen: jnp.ndarray  # int32 flush-timer generation
     # partition logs [P, L] (entries < log_len valid; < flushed durable)
     log_src: jnp.ndarray  # int32[P, L] producer index
@@ -169,11 +187,13 @@ def _consumer_node(cfg: KafkaConfig, c):
 
 def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     """Producer p sends its lowest unacked seq to the broker and re-arms
-    (retry-until-ack — at-least-once delivery, duplicates possible)."""
+    (retry-until-ack — at-least-once delivery, duplicates possible). A
+    crashed/paused producer's timer keeps ticking but sends nothing."""
     p = pay[0]
     seq = get1(w.next_seq, p)
-    active = seq < cfg.msgs_per_producer
     node = _producer_node(p)
+    has_work = seq < cfg.msgs_per_producer
+    active = has_work & get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
     send = active & deliver
     msg = _pay(BROKER, MT_PRODUCE, node, seq)
@@ -182,7 +202,7 @@ def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
         cfg,
         _no_bcast(cfg),
         (t, K_MSG, msg, send),
-        (now + interval, K_PRODUCE, _pay(p), active),
+        (now + interval, K_PRODUCE, _pay(p), has_work),
     )
     w2 = w._replace(
         produced=w.produced + jnp.where(active, 1, 0),
@@ -193,21 +213,23 @@ def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
 
 
 def _on_fetch_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
-    """Consumer c polls the broker from its current offset and re-arms."""
+    """Consumer c polls the broker from its current offset and re-arms; a
+    crashed/paused consumer's timer keeps ticking but sends nothing."""
     c = pay[0]
     node = _consumer_node(cfg, c)
+    can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
     msg = _pay(BROKER, MT_FETCH, node, get1(w.cons_off, c))
     interval = bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns)
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (t, K_MSG, msg, deliver),
+        (t, K_MSG, msg, can_send & deliver),
         (now + interval, K_FETCH, _pay(c), True),
     )
     w2 = w._replace(
-        msgs_sent=w.msgs_sent + 1,
-        msgs_delivered=w.msgs_delivered + jnp.where(deliver, 1, 0),
+        msgs_sent=w.msgs_sent + jnp.where(can_send, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(can_send & deliver, 1, 0),
     )
     return w2, emits
 
@@ -230,7 +252,7 @@ def _compute_dur_upto(cfg: KafkaConfig, log_src, log_seq, flushed):
 def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     dst, mtype, src, a, b = pay[0], pay[1], pay[2], pay[3], pay[4]
     at_broker = dst == BROKER
-    alive = w.alive
+    alive = get1(efaults.up(w.fstate), BROKER)
 
     # -- broker: PRODUCE — append at log end (broker.rs:80-101); keyed
     # assignment producer → partition (src is the producer's node id)
@@ -269,8 +291,16 @@ def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     avail = get1(w.flushed, part_c)
     nrec = jnp.clip(avail - off, 0, cfg.fetch_max)
 
-    # -- producer: ACK (cumulative) — advance next_seq past the frontier
-    is_ack = (mtype == MT_ACK) & (dst >= 1) & (dst <= cfg.num_producers)
+    # -- producer: ACK (cumulative) — advance next_seq past the frontier;
+    # a crashed/paused client drops in-flight receives, like the host
+    # tier's kill (tasks die, nothing processes the delivery)
+    up = efaults.up(w.fstate)
+    is_ack = (
+        (mtype == MT_ACK)
+        & (dst >= 1)
+        & (dst <= cfg.num_producers)
+        & get1(up, dst)
+    )
     ack_dst = dst - 1
     adv = jnp.maximum(get1(w.next_seq, ack_dst), a + 1)
     next_seq2 = set1(w.next_seq, ack_dst, adv, is_ack)
@@ -278,7 +308,7 @@ def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     # -- consumer: FETCH_RSP — advance only on a response matching the
     # current offset (stale responses from earlier polls are dropped),
     # keeping the consumed stream contiguous and monotonic
-    is_rsp = (mtype == MT_FETCH_RSP) & (dst > cfg.num_producers)
+    is_rsp = (mtype == MT_FETCH_RSP) & (dst > cfg.num_producers) & get1(up, dst)
     rsp_c = dst - 1 - cfg.num_producers
     match = is_rsp & (a == get1(w.cons_off, rsp_c))
     cons_off2 = set1(w.cons_off, rsp_c, a + b, match)
@@ -321,7 +351,7 @@ def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     is also the ack point — one cumulative ack per producer whose durable
     frontier moved."""
     gen = pay[0]
-    valid = w.alive & (gen == w.bgen)
+    valid = get1(efaults.up(w.fstate), BROKER) & (gen == w.bgen)
     flushed2 = jnp.where(valid, w.log_len, w.flushed)
     dur2 = jnp.where(
         valid,
@@ -383,33 +413,47 @@ def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     return w2, emits
 
 
-def _on_crash(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
-    """Broker crash: everything newer than the durable watermark is lost
-    (ref kill semantics task/mod.rs:347-364). THE checker moment: any
-    acked-but-not-durable seq is acknowledged data loss."""
-    was_alive = w.alive
+def _on_fault(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
+    """One event of the compiled fault campaign (engine/faults.py). The
+    shared interpreter updates liveness/pause masks and the LinkState;
+    this handler adds the Kafka-specific consequences for the broker:
+
+    - crash: everything newer than the durable watermark is lost (ref
+      kill semantics task/mod.rs:347-364) — THE checker moment: any
+      acked-but-not-durable seq is acknowledged data loss.
+    - pause: the flush-timer chain dies (bgen bump) but no data is lost.
+    - restart/resume: a fresh flush-timer chain from durable state.
+
+    Client-node faults need no handler work: producer/consumer timers
+    gate their sends — and _on_msg their receives — on the shared
+    liveness mask directly."""
+    action, victim = pay[0], pay[1]
+    base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
+    links2, f2, e = efaults.on_event(
+        fault_spec(cfg), base, w.links, w.fstate, action, victim
+    )
+    at_broker = victim == BROKER
+    crashed = e.crashed & at_broker
+    stopped = (e.crashed | e.paused) & at_broker  # flush chain must die
+    revived = (e.restarted | e.resumed) & at_broker  # ... and be re-armed
+
     lost_acked = jnp.any(w.ack_upto > w.dur_upto)
     bad_wm = jnp.any(w.flushed > w.log_len)
+    bgen2 = w.bgen + jnp.where(stopped, 1, 0)
     w2 = w._replace(
-        alive=jnp.zeros((), bool),
-        bgen=w.bgen + jnp.where(was_alive, 1, 0),
-        log_len=jnp.where(was_alive, w.flushed, w.log_len),
-        vio_ack_loss=w.vio_ack_loss | (was_alive & lost_acked),
-        vio_watermark=w.vio_watermark | (was_alive & bad_wm),
-        violation=w.violation | (was_alive & (lost_acked | bad_wm)),
-        crash_count=w.crash_count + jnp.where(was_alive, 1, 0),
+        links=links2,
+        fstate=f2,
+        bgen=bgen2,
+        log_len=jnp.where(crashed, w.flushed, w.log_len),
+        vio_ack_loss=w.vio_ack_loss | (crashed & lost_acked),
+        vio_watermark=w.vio_watermark | (crashed & bad_wm),
+        violation=w.violation | (crashed & (lost_acked | bad_wm)),
+        crash_count=w.crash_count + jnp.where(crashed, 1, 0),
     )
-    return w2, _emits(cfg, _no_bcast(cfg), _DISABLED, _DISABLED)
-
-
-def _on_restart(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
-    """Broker restart from durable state; fresh flush-timer chain."""
-    was_dead = ~w.alive
-    w2 = w._replace(alive=jnp.ones((), bool))
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (now + cfg.flush_interval_ns, K_FLUSH, _pay(w.bgen), was_dead),
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(bgen2), revived),
         _DISABLED,
     )
     return w2, emits
@@ -421,20 +465,19 @@ def _handle(cfg: KafkaConfig, w: KafkaState, now, kind, pay, rand):
         partial(_on_fetch_timer, cfg),
         partial(_on_msg, cfg),
         partial(_on_flush, cfg),
-        partial(_on_crash, cfg),
-        partial(_on_restart, cfg),
+        partial(_on_fault, cfg),
     ]
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
 def _init(cfg: KafkaConfig, key):
     np_, nc = cfg.num_producers, cfg.num_consumers
-    ninit = np_ + nc + 1 + 2 * cfg.crashes
+    ninit = np_ + nc + 1
     rand = jax.random.bits(
         jax.random.fold_in(key, 0x7FFF_FFFF), (ninit,), dtype=jnp.uint32
     )
     w = KafkaState(
-        alive=jnp.ones((), bool),
+        fstate=efaults.init_state(cfg.num_nodes),
         bgen=jnp.zeros((), jnp.int32),
         log_src=jnp.full((cfg.partitions, cfg.log_cap), -1, jnp.int32),
         log_seq=jnp.full((cfg.partitions, cfg.log_cap), -1, jnp.int32),
@@ -479,18 +522,16 @@ def _init(cfg: KafkaConfig, key):
     times = times.at[i].set(jnp.int64(cfg.flush_interval_ns))
     kinds = kinds.at[i].set(K_FLUSH)
     pays = pays.at[i].set(_pay(0))
-    # broker crash/restart plan
-    base = np_ + nc + 1
-    for k in range(cfg.crashes):
-        t_crash = bounded(rand[base + 2 * k], 0, cfg.crash_window_ns)
-        delay = bounded(
-            rand[base + 2 * k + 1], cfg.restart_lo_ns, cfg.restart_hi_ns
-        )
-        times = times.at[base + 2 * k].set(t_crash)
-        kinds = kinds.at[base + 2 * k].set(K_CRASH)
-        times = times.at[base + 2 * k + 1].set(t_crash + delay)
-        kinds = kinds.at[base + 2 * k + 1].set(K_RESTART)
-    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+    # fault campaign: the shared compiler's event stream, spliced in
+    fe = efaults.compile_device(
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+    )
+    return w, Emits(
+        times=jnp.concatenate([times, fe.times]),
+        kinds=jnp.concatenate([kinds, fe.kinds]),
+        pays=jnp.concatenate([pays, fe.pays]),
+        enables=jnp.concatenate([enables, fe.enables]),
+    )
 
 
 @_common.memoized_workload(KafkaConfig)
@@ -515,7 +556,7 @@ def engine_config(cfg: KafkaConfig = KafkaConfig(), **overrides) -> EngineConfig
             48,
             4 * (cfg.num_producers + cfg.num_consumers)
             + cfg.num_nodes
-            + 2 * cfg.crashes
+            + efaults.num_events(fault_spec(cfg))
             + 4,
         ),
         time_limit_ns=5_000_000_000,

@@ -43,11 +43,12 @@ Design notes:
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..engine import faults as efaults
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, get2, geti, set1, set2
@@ -58,9 +59,8 @@ from . import _common
 K_ELECTION = 0  # pay = (node, tgen)
 K_HEARTBEAT = 1  # pay = (node, lepoch)
 K_MSG = 2  # pay = (dst, mtype, src, term, a, b, c, d)
-K_CRASH = 3  # pay = (node,)
-K_RESTART = 4  # pay = (node,)
-K_CMD = 5  # pay = (target, retries) — a client command seeking the leader
+K_FAULT = 3  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
+K_CMD = 4  # pay = (target, retries) — a client command seeking the leader
 
 # message types
 M_REQ_VOTE = 0  # a=last_log_idx, b=last_log_term
@@ -93,8 +93,8 @@ class RaftConfig(NamedTuple):
     # until the time limit in partitioned seeds
     cmd_max_retries: int = 64
     log_cap: int = 32
-    # fault plan: `crashes` node-crash events at random times in the first
-    # `crash_window_ns`, each restarting after a random delay
+    # legacy crash-storm shorthand, compiled through engine/faults.py;
+    # `faults` (below) overrides all four when set
     crashes: int = 2
     crash_window_ns: int = 5_000_000_000
     restart_lo_ns: int = 100_000_000
@@ -113,6 +113,22 @@ class RaftConfig(NamedTuple):
     # vote. Used by the cross-tier replay pipeline (madsim_tpu/replay.py)
     # to find device seeds whose fault schedule breaks host-tier user code.
     volatile_state: bool = False
+    # full declarative fault campaign (engine/faults.FaultSpec); None =
+    # derive a crash-storm spec from the legacy fields above
+    faults: Optional[efaults.FaultSpec] = None
+
+
+def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
+    """The campaign this config compiles: ``cfg.faults`` verbatim, or the
+    legacy crash-storm fields lifted into a FaultSpec."""
+    if cfg.faults is not None:
+        return cfg.faults
+    return efaults.FaultSpec(
+        crashes=cfg.crashes,
+        crash_window_ns=cfg.crash_window_ns,
+        restart_lo_ns=cfg.restart_lo_ns,
+        restart_hi_ns=cfg.restart_hi_ns,
+    )
 
 
 class RaftState(NamedTuple):
@@ -121,7 +137,7 @@ class RaftState(NamedTuple):
     term: jnp.ndarray  # int32
     voted: jnp.ndarray  # int32, -1 = none
     votes: jnp.ndarray  # uint32 bitmask of granted votes
-    alive: jnp.ndarray  # bool
+    fstate: efaults.FaultState  # shared liveness/pause/partition/burst state
     last_hb: jnp.ndarray  # int64, last time a valid leader signal arrived
     tgen: jnp.ndarray  # int32 election-timer generation
     lepoch: jnp.ndarray  # int32 leadership epoch (heartbeat-timer guard)
@@ -246,7 +262,7 @@ def _append_pays(cfg: RaftConfig, w: RaftState, leader, term) -> jnp.ndarray:
 
 def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node, gen = pay[0], pay[1]
-    valid = get1(w.alive, node) & (gen == get1(w.tgen, node)) & (
+    valid = get1(efaults.up(w.fstate), node) & (gen == get1(w.tgen, node)) & (
         get1(w.role, node) != LEADER
     )
     # a live leader/candidate signal arrived since this timer was armed?
@@ -282,7 +298,7 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
 
 def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     node, epoch = pay[0], pay[1]
-    valid = get1(w.alive, node) & (get1(w.role, node) == LEADER) & (
+    valid = get1(efaults.up(w.fstate), node) & (get1(w.role, node) == LEADER) & (
         epoch == get1(w.lepoch, node)
     )
     term = get1(w.term, node)
@@ -302,7 +318,7 @@ def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
 def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     dst, mtype, src, mterm = pay[0], pay[1], pay[2], pay[3]
     a, b, c, d = pay[4], pay[5], pay[6], pay[7]
-    live = get1(w.alive, dst)
+    live = get1(efaults.up(w.fstate), dst)
     role_dst = get1(w.role, dst)
     was_leader = live & (role_dst == LEADER)
 
@@ -473,44 +489,70 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     return w2, emits
 
 
-def _on_crash(cfg: RaftConfig, w: RaftState, now, pay, rand):
-    node = pay[0]
-    # durable state (term, voted, log) survives; volatile state resets
-    # (ref kill semantics: task/mod.rs:347-364 — tasks dropped, state wiped)
+def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
+    """One event of the compiled fault campaign (engine/faults.py). The
+    shared interpreter updates liveness/pause masks and the LinkState;
+    this handler adds the Raft-specific consequences:
+
+    - crash: volatile state resets (role, votes, commit) while durable
+      state (term, voted, log) survives — ref kill semantics
+      task/mod.rs:347-364 — plus the amnesia wipe in ``volatile_state``
+      mode; timer chains are invalidated by generation bumps.
+    - pause: timer chains are invalidated the same way (the paused node's
+      clock stops), but no state is lost.
+    - restart/resume: a restarted (or resumed non-leader) node re-enters
+      the election-timer chain; a resumed LEADER keeps its role, so it
+      re-enters the heartbeat chain instead — as on the host tier, where
+      ``Handle.resume`` lets the leader's tasks heartbeat on (a deposed
+      leader's election timer comes from the demotion path in _on_msg).
+    """
+    action, victim = pay[0], pay[1]
+    base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
+    links2, f2, e = efaults.on_event(
+        fault_spec(cfg), base, w.links, w.fstate, action, victim
+    )
+    crashed, restarted, resumed = e.crashed, e.restarted, e.resumed
+    stopped = crashed | e.paused  # the node's event chains must die
+    revived = restarted | resumed  # the node needs a fresh timer chain
+
     w2 = w._replace(
-        alive=set1(w.alive, node, False),
-        role=set1(w.role, node, FOLLOWER),
-        votes=set1(w.votes, node, jnp.uint32(0)),
-        commit=set1(w.commit, node, 0),
-        tgen=set1(w.tgen, node, get1(w.tgen, node) + 1),
-        lepoch=set1(w.lepoch, node, get1(w.lepoch, node) + 1),
+        links=links2,
+        fstate=f2,
+        role=set1(w.role, victim, FOLLOWER, crashed | restarted),
+        votes=set1(w.votes, victim, jnp.uint32(0), crashed),
+        commit=set1(w.commit, victim, 0, crashed),
+        tgen=set1(w.tgen, victim, get1(w.tgen, victim) + 1, stopped),
+        lepoch=set1(w.lepoch, victim, get1(w.lepoch, victim) + 1, stopped),
+        last_hb=set1(w.last_hb, victim, now, revived),
     )
     if cfg.volatile_state:
         # amnesia mode: the "durable" state dies with the process too
         # (what host-tier code that keeps everything in memory does)
         w2 = w2._replace(
-            term=set1(w2.term, node, 0),
-            voted=set1(w2.voted, node, -1),
-            log_len=set1(w2.log_len, node, 0),
-            log_term=set1(w2.log_term, node, jnp.zeros((cfg.log_cap,), jnp.int32)),
+            term=set1(w2.term, victim, 0, crashed),
+            voted=set1(w2.voted, victim, -1, crashed),
+            log_len=set1(w2.log_len, victim, 0, crashed),
+            log_term=set1(
+                w2.log_term, victim, jnp.zeros((cfg.log_cap,), jnp.int32), crashed
+            ),
         )
-    return w2, _emits(cfg, _no_bcast(cfg), _DISABLED_EXTRA, _DISABLED_EXTRA)
-
-
-def _on_restart(cfg: RaftConfig, w: RaftState, now, pay, rand):
-    node = pay[0]
-    was_dead = ~get1(w.alive, node)
-    w2 = w._replace(
-        alive=set1(w.alive, node, True),
-        role=set1(w.role, node, FOLLOWER, was_dead),
-        last_hb=set1(w.last_hb, node, now, was_dead),
-    )
     timeout = bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns)
+    still_leader = get1(w2.role, victim) == LEADER  # only a resumed leader
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (now + timeout, K_ELECTION, _pay(node, get1(w2.tgen, node)), was_dead),
-        _DISABLED_EXTRA,
+        (
+            now + timeout,
+            K_ELECTION,
+            _pay(victim, get1(w2.tgen, victim)),
+            revived & ~still_leader,
+        ),
+        (
+            now + cfg.heartbeat_ns,
+            K_HEARTBEAT,
+            _pay(victim, get1(w2.lepoch, victim)),
+            resumed & still_leader,
+        ),
     )
     return w2, emits
 
@@ -520,7 +562,9 @@ def _on_cmd(cfg: RaftConfig, w: RaftState, now, pay, rand):
     live leader with log room, append an entry of its term; otherwise
     retry against the next node after cmd_retry_ns."""
     target, retries = pay[0], pay[1]
-    is_leader = get1(w.alive, target) & (get1(w.role, target) == LEADER)
+    is_leader = get1(efaults.up(w.fstate), target) & (
+        get1(w.role, target) == LEADER
+    )
     slot = get1(w.log_len, target) + 1
     room = slot < cfg.log_cap
     accept = is_leader & room
@@ -552,8 +596,7 @@ def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
         partial(_on_election_timer, cfg),
         partial(_on_heartbeat_timer, cfg),
         partial(_on_msg, cfg),
-        partial(_on_crash, cfg),
-        partial(_on_restart, cfg),
+        partial(_on_fault, cfg),
         partial(_on_cmd, cfg),
     ]
     return jax.lax.switch(kind, branches, w, now, pay, rand)
@@ -561,12 +604,13 @@ def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
 
 def _init(cfg: RaftConfig, key):
     n = cfg.num_nodes
-    ninit = n + 2 * cfg.crashes + cfg.commands
+    ninit = n + cfg.commands
     # init draws live in their own counter namespace, disjoint from the
-    # per-event stream (event counters stay far below 2**31)
+    # per-event stream (event counters stay far below 2**31) and from the
+    # fault-schedule namespace (engine/faults.FAULT_STREAM)
     rand = jax.random.bits(
         jax.random.fold_in(key, 0x7FFF_FFFF),
-        (ninit + cfg.crashes + cfg.commands,),
+        (n + 2 * cfg.commands,),
         dtype=jnp.uint32,
     )
     w = RaftState(
@@ -574,7 +618,7 @@ def _init(cfg: RaftConfig, key):
         term=jnp.zeros((n,), jnp.int32),
         voted=jnp.full((n,), -1, jnp.int32),
         votes=jnp.zeros((n,), jnp.uint32),
-        alive=jnp.ones((n,), bool),
+        fstate=efaults.init_state(n),
         last_hb=jnp.zeros((n,), jnp.int64),
         tgen=jnp.zeros((n,), jnp.int32),
         lepoch=jnp.zeros((n,), jnp.int32),
@@ -610,26 +654,21 @@ def _init(cfg: RaftConfig, key):
         times = times.at[i].set(bounded(rand[i], cfg.election_lo_ns, cfg.election_hi_ns))
         kinds = kinds.at[i].set(K_ELECTION)
         pays = pays.at[i].set(_pay(i, 0))
-    # fault plan: crash (node, t) then restart after a random delay
-    for c in range(cfg.crashes):
-        t_crash = bounded(rand[n + 2 * c], 0, cfg.crash_window_ns)
-        delay = bounded(rand[n + 2 * c + 1], cfg.restart_lo_ns, cfg.restart_hi_ns)
-        victim = bounded(rand[ninit + c], 0, n).astype(jnp.int32)
-        times = times.at[n + 2 * c].set(t_crash)
-        kinds = kinds.at[n + 2 * c].set(K_CRASH)
-        pays = pays.at[n + 2 * c].set(_pay(victim))
-        times = times.at[n + 2 * c + 1].set(t_crash + delay)
-        kinds = kinds.at[n + 2 * c + 1].set(K_RESTART)
-        pays = pays.at[n + 2 * c + 1].set(_pay(victim))
     # client command plan
-    base = n + 2 * cfg.crashes
     for k in range(cfg.commands):
-        t_cmd = bounded(rand[base + k], 0, cfg.cmd_window_ns)
-        target = bounded(rand[ninit + cfg.crashes + k], 0, n).astype(jnp.int32)
-        times = times.at[base + k].set(t_cmd)
-        kinds = kinds.at[base + k].set(K_CMD)
-        pays = pays.at[base + k].set(_pay(target, 0))
-    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+        t_cmd = bounded(rand[n + 2 * k], 0, cfg.cmd_window_ns)
+        target = bounded(rand[n + 2 * k + 1], 0, n).astype(jnp.int32)
+        times = times.at[n + k].set(t_cmd)
+        kinds = kinds.at[n + k].set(K_CMD)
+        pays = pays.at[n + k].set(_pay(target, 0))
+    # fault campaign: the shared compiler's event stream, spliced in
+    fe = efaults.compile_device(fault_spec(cfg), n, key, K_FAULT, PAYLOAD_SLOTS)
+    return w, Emits(
+        times=jnp.concatenate([times, fe.times]),
+        kinds=jnp.concatenate([kinds, fe.kinds]),
+        pays=jnp.concatenate([pays, fe.pays]),
+        enables=jnp.concatenate([enables, fe.enables]),
+    )
 
 
 @_common.memoized_workload(RaftConfig)
@@ -656,7 +695,10 @@ def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
     so an undersized queue is observable, never silent)."""
     defaults = dict(
         queue_capacity=max(
-            48, 2 * cfg.num_nodes * cfg.num_nodes + cfg.commands + 2 * cfg.crashes
+            48,
+            2 * cfg.num_nodes * cfg.num_nodes
+            + cfg.commands
+            + efaults.num_events(fault_spec(cfg)),
         ),
         time_limit_ns=10_000_000_000,
         max_steps=200_000,

@@ -50,11 +50,12 @@ Design notes (shared with models/kafka.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple
+from typing import NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
 
+from ..engine import faults as efaults
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, set1
@@ -65,8 +66,7 @@ from . import _common
 K_OP = 0  # pay = (client,) — client timer: start or re-send current op
 K_MSG = 1  # pay = (dst_node, mtype, src_node, a, b)
 K_FLUSH = 2  # pay = (sgen,) — server durability timer (bug mode)
-K_CRASH = 3  # server crash (fault plan)
-K_RESTART = 4  # server restart
+K_FAULT = 3  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
 
 # message types (pay slots a/b per type)
 MT_PUT = 1  # a = key, b = len
@@ -112,7 +112,8 @@ class S3Config(NamedTuple):
     # server durability cadence (only meaningful in bug mode — correct
     # mode makes every commit durable synchronously, the S3 contract)
     flush_interval_ns: int = 200_000_000
-    # fault plan: server crash/restart events in the first crash_window_ns
+    # legacy server-crash shorthand, compiled through engine/faults.py;
+    # `faults` (below) overrides all four when set
     crashes: int = 1
     crash_window_ns: int = 3_000_000_000
     restart_lo_ns: int = 100_000_000
@@ -126,15 +127,32 @@ class S3Config(NamedTuple):
     # defer durability to the periodic flush — crash in between loses
     # acknowledged objects
     bug_ack_before_durable: bool = False
+    # full declarative fault campaign (engine/faults.FaultSpec); None =
+    # derive a server-crash spec from the legacy fields above
+    faults: Optional[efaults.FaultSpec] = None
 
     @property
     def num_nodes(self) -> int:
         return 1 + self.num_clients
 
 
+def fault_spec(cfg: S3Config) -> efaults.FaultSpec:
+    """``cfg.faults`` verbatim, or the legacy server-crash fields lifted
+    into a FaultSpec targeting the server node only."""
+    if cfg.faults is not None:
+        return cfg.faults
+    return efaults.FaultSpec(
+        crashes=cfg.crashes,
+        crash_window_ns=cfg.crash_window_ns,
+        restart_lo_ns=cfg.restart_lo_ns,
+        restart_hi_ns=cfg.restart_hi_ns,
+        crash_group=(SERVER, SERVER + 1),
+    )
+
+
 class S3State(NamedTuple):
-    # server
-    alive: jnp.ndarray  # bool
+    # shared liveness/pause/partition/burst state (server is node 0)
+    fstate: efaults.FaultState
     sgen: jnp.ndarray  # int32 flush-timer generation
     # committed object table [K] (version 0 = never written, len -1 = absent)
     ver_com: jnp.ndarray  # int32[K]
@@ -203,7 +221,8 @@ def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
     c = pay[0]
     phase = get1(w.phase, c)
     budget_left = get1(w.ops_done, c) < cfg.ops_per_client
-    start = (phase == IDLE) & budget_left
+    node_up = get1(efaults.up(w.fstate), jnp.asarray(c, jnp.int32) + 1)
+    start = (phase == IDLE) & budget_left & node_up
 
     op = bounded(rand[3], 0, 8)
     op_phase = jnp.take(jnp.array(_OP_PHASE, jnp.int32), op)
@@ -222,14 +241,14 @@ def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
         phase2 == P_PUT, len2, jnp.where(phase2 == P_MPP, part, 0)
     )
 
-    active = phase2 != IDLE
+    active = (phase2 != IDLE) & node_up
     node = jnp.asarray(c, jnp.int32) + 1
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
     send = active & deliver
     interval = bounded(rand[2], cfg.op_lo_ns, cfg.op_hi_ns)
     emits = _emits(
         (t, K_MSG, _pay(SERVER, mtype, node, a, b), send),
-        (now + interval, K_OP, _pay(c), active | budget_left),
+        (now + interval, K_OP, _pay(c), (phase2 != IDLE) | budget_left),
     )
     w2 = w._replace(
         phase=set1(w.phase, c, phase2, start),
@@ -244,7 +263,7 @@ def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
 def _on_msg(cfg: S3Config, w: S3State, now, pay, rand):
     dst, mtype, src, a, b = pay[0], pay[1], pay[2], pay[3], pay[4]
     at_server = dst == SERVER
-    alive = w.alive
+    alive = get1(efaults.up(w.fstate), SERVER)
     srv = at_server & alive
     cc = jnp.clip(src - 1, 0, cfg.num_clients - 1)  # requesting client
     sync = not cfg.bug_ack_before_durable  # static: commit == durable
@@ -360,7 +379,11 @@ def _on_msg(cfg: S3Config, w: S3State, now, pay, rand):
     reply_on = did_req & rdeliver
 
     # -- client: response handling (stale responses gated by phase/gen)
-    at_client = (dst >= 1) & (mtype >= MT_PUT_ACK)
+    at_client = (
+        (dst >= 1)
+        & (mtype >= MT_PUT_ACK)
+        & get1(efaults.up(w.fstate), dst)
+    )
     rc = jnp.clip(dst - 1, 0, cfg.num_clients - 1)
     cphase = get1(w.phase, rc)
     cgen = get1(w.cur_gen, rc)
@@ -450,7 +473,7 @@ def _on_flush(cfg: S3Config, w: S3State, now, pay, rand):
     be a no-op event every interval (statically gated out in _init /
     _on_restart)."""
     gen = pay[0]
-    valid = w.alive & (gen == w.sgen)
+    valid = get1(efaults.up(w.fstate), SERVER) & (gen == w.sgen)
     w2 = w._replace(
         ver_dur=jnp.where(valid, w.ver_com, w.ver_dur),
         len_dur=jnp.where(valid, w.len_com, w.len_dur),
@@ -462,38 +485,48 @@ def _on_flush(cfg: S3Config, w: S3State, now, pay, rand):
     return w2, emits
 
 
-def _on_crash(cfg: S3Config, w: S3State, now, pay, rand):
-    """Server crash: committed state rolls back to the durable tier and
-    every staged multipart upload is aborted (ref kill semantics
-    task/mod.rs:347-364). THE checker moment: any acked version without a
-    durable copy is an acknowledged-durability breach."""
-    was_alive = w.alive
+def _on_fault(cfg: S3Config, w: S3State, now, pay, rand):
+    """One event of the compiled fault campaign (engine/faults.py). The
+    shared interpreter updates liveness/pause masks and the LinkState;
+    this handler adds the S3-specific server consequences:
+
+    - crash: committed state rolls back to the durable tier and every
+      staged multipart upload is aborted (ref kill semantics
+      task/mod.rs:347-364) — THE checker moment: any acked version
+      without a durable copy is an acknowledged-durability breach.
+    - pause: the flush-timer chain dies (sgen bump), nothing is lost.
+    - restart/resume: a fresh flush-timer chain (bug mode only — correct
+      mode commits durably at processing time, see _on_flush)."""
+    action, victim = pay[0], pay[1]
+    base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
+    links2, f2, e = efaults.on_event(
+        fault_spec(cfg), base, w.links, w.fstate, action, victim
+    )
+    at_server = victim == SERVER
+    crashed = e.crashed & at_server
+    stopped = (e.crashed | e.paused) & at_server
+    revived = (e.restarted | e.resumed) & at_server
+
     lost = jnp.any(w.last_acked_ver > w.ver_dur)
     nc = cfg.num_clients
+    sgen2 = w.sgen + jnp.where(stopped, 1, 0)
     w2 = w._replace(
-        alive=jnp.zeros((), bool),
-        sgen=w.sgen + jnp.where(was_alive, 1, 0),
-        ver_com=jnp.where(was_alive, w.ver_dur, w.ver_com),
-        len_com=jnp.where(was_alive, w.len_dur, w.len_com),
-        mp_gen=jnp.where(was_alive, jnp.zeros((nc,), jnp.int32), w.mp_gen),
+        links=links2,
+        fstate=f2,
+        sgen=sgen2,
+        ver_com=jnp.where(crashed, w.ver_dur, w.ver_com),
+        len_com=jnp.where(crashed, w.len_dur, w.len_com),
+        mp_gen=jnp.where(crashed, jnp.zeros((nc,), jnp.int32), w.mp_gen),
         mp_done_gen=jnp.where(
-            was_alive, jnp.zeros((nc,), jnp.int32), w.mp_done_gen
+            crashed, jnp.zeros((nc,), jnp.int32), w.mp_done_gen
         ),
-        vio_ack_loss=w.vio_ack_loss | (was_alive & lost),
-        violation=w.violation | (was_alive & lost),
-        crash_count=w.crash_count + jnp.where(was_alive, 1, 0),
+        vio_ack_loss=w.vio_ack_loss | (crashed & lost),
+        violation=w.violation | (crashed & lost),
+        crash_count=w.crash_count + jnp.where(crashed, 1, 0),
     )
-    return w2, _emits(_DISABLED, _DISABLED)
-
-
-def _on_restart(cfg: S3Config, w: S3State, now, pay, rand):
-    """Server restart from durable state; fresh flush-timer chain (bug
-    mode only — see _on_flush)."""
-    was_dead = ~w.alive
-    rearm = was_dead if cfg.bug_ack_before_durable else jnp.zeros((), bool)
-    w2 = w._replace(alive=jnp.ones((), bool))
+    rearm = revived if cfg.bug_ack_before_durable else jnp.zeros((), bool)
     emits = _emits(
-        (now + cfg.flush_interval_ns, K_FLUSH, _pay(w.sgen), rearm),
+        (now + cfg.flush_interval_ns, K_FLUSH, _pay(sgen2), rearm),
         _DISABLED,
     )
     return w2, emits
@@ -504,20 +537,19 @@ def _handle(cfg: S3Config, w: S3State, now, kind, pay, rand):
         partial(_on_op_timer, cfg),
         partial(_on_msg, cfg),
         partial(_on_flush, cfg),
-        partial(_on_crash, cfg),
-        partial(_on_restart, cfg),
+        partial(_on_fault, cfg),
     ]
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
 def _init(cfg: S3Config, key):
     nc, k = cfg.num_clients, cfg.num_keys
-    ninit = nc + 1 + 2 * cfg.crashes
+    ninit = nc + 1
     rand = jax.random.bits(
         jax.random.fold_in(key, 0x7FFF_FFFF), (ninit,), dtype=jnp.uint32
     )
     w = S3State(
-        alive=jnp.ones((), bool),
+        fstate=efaults.init_state(cfg.num_nodes),
         sgen=jnp.zeros((), jnp.int32),
         ver_com=jnp.zeros((k,), jnp.int32),
         len_com=jnp.full((k,), -1, jnp.int32),
@@ -569,18 +601,16 @@ def _init(cfg: S3Config, key):
     pays = pays.at[i].set(_pay(0))
     if not cfg.bug_ack_before_durable:
         enables = enables.at[i].set(False)
-    # server crash/restart plan
-    base = nc + 1
-    for j in range(cfg.crashes):
-        t_crash = bounded(rand[base + 2 * j], 0, cfg.crash_window_ns)
-        delay = bounded(
-            rand[base + 2 * j + 1], cfg.restart_lo_ns, cfg.restart_hi_ns
-        )
-        times = times.at[base + 2 * j].set(t_crash)
-        kinds = kinds.at[base + 2 * j].set(K_CRASH)
-        times = times.at[base + 2 * j + 1].set(t_crash + delay)
-        kinds = kinds.at[base + 2 * j + 1].set(K_RESTART)
-    return w, Emits(times=times, kinds=kinds, pays=pays, enables=enables)
+    # fault campaign: the shared compiler's event stream, spliced in
+    fe = efaults.compile_device(
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+    )
+    return w, Emits(
+        times=jnp.concatenate([times, fe.times]),
+        kinds=jnp.concatenate([kinds, fe.kinds]),
+        pays=jnp.concatenate([pays, fe.pays]),
+        enables=jnp.concatenate([enables, fe.enables]),
+    )
 
 
 @_common.memoized_workload(S3Config)
@@ -601,7 +631,10 @@ def engine_config(cfg: S3Config = S3Config(), **overrides) -> EngineConfig:
     timer chain + ≤1 in-flight request per client, ≤1 reply per request,
     the flush chain, and the fault plan."""
     defaults = dict(
-        queue_capacity=max(48, 4 * cfg.num_clients + 8 + 2 * cfg.crashes),
+        queue_capacity=max(
+            48,
+            4 * cfg.num_clients + 8 + efaults.num_events(fault_spec(cfg)),
+        ),
         time_limit_ns=5_000_000_000,
         max_steps=200_000,
     )

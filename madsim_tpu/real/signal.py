@@ -23,8 +23,15 @@ _installed_loop: Optional[asyncio.AbstractEventLoop] = None
 def _on_sigint() -> None:
     waiters, _waiters[:] = list(_waiters), []
     for fut in waiters:
-        if not fut.done():
+        if fut.done() or fut.get_loop().is_closed():
+            # a waiter whose runtime was abandoned without cancellation
+            # leaves a future bound to a closed loop; resolving it would
+            # raise mid-iteration and strand every later live waiter
+            continue
+        try:
             fut.set_result(None)
+        except RuntimeError:
+            pass  # loop torn down between the check and the call
 
 
 async def ctrl_c() -> None:

@@ -6,12 +6,19 @@ The sweep→replay pipeline (SURVEY.md §7 stage 5 acceptance):
 2. ``engine.run_traced`` re-runs one seed on the CPU backend — the
    integer-only engine makes the replay bit-exact, so the violation is
    confirmed and the full event schedule is captured;
-3. ``extract_fault_plan`` lifts the *externally injected* schedule — the
-   crash/restart fault events the simulator decided — out of the trace;
-4. the plan drives a host-tier supervisor (e.g.
+3. ``extract_fault_schedule`` lifts the *externally injected* schedule —
+   the compiled fault campaign's crash/restart, partition/heal, latency/
+   loss-burst and pause/resume events (engine/faults.py) — out of the
+   trace, with the exact scheduled deadlines the payloads carry;
+4. the schedule drives a host-tier supervisor
+   (``madsim_tpu.faults.apply_schedule``, e.g. via
    ``examples/raft_host.run_seed_with_plan``) that applies the same
-   kills/restarts at the same virtual times to ordinary async user code,
-   where a debugger, print statements, or tracing spans can attach.
+   faults at the same virtual times to ordinary async user code, where a
+   debugger, print statements, or tracing spans can attach. Because both
+   tiers compile the same ``FaultSpec`` (madsim_tpu/faults.compile_host
+   == the device schedule, tests/test_faults.py), the trace hop is
+   optional: the spec plus the violating seed already reproduce the
+   fault environment.
 
 Step 4 is the semantic bridge the reference gets for free by running one
 engine for everything (``MADSIM_TEST_SEED=N`` reruns the same binary,
@@ -28,7 +35,7 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-FaultEvent = Tuple[int, str, int]  # (time_ns, "crash" | "restart", node)
+FaultEvent = Tuple[int, str, int]  # (time_ns, action name, victim node)
 
 
 def amnesia_raft_config():
@@ -60,27 +67,32 @@ def violation_seeds(final) -> np.ndarray:
     return np.asarray(final.seed)[np.asarray(final.wstate.violation)]
 
 
-def extract_fault_plan(
-    trace: Dict, crash_kind: int, restart_kind: int, node_slot: int = 0
-) -> List[FaultEvent]:
-    """Lift the fired crash/restart events out of a ``run_traced`` trace.
+def extract_fault_schedule(trace: Dict, fault_kind: int) -> List[FaultEvent]:
+    """Lift the fired fault-campaign events out of a ``run_traced`` trace.
 
-    ``trace`` is the dict returned by ``engine.run_traced``; ``crash_kind``
-    / ``restart_kind`` are the workload's event-kind codes (e.g.
-    ``models.raft.K_CRASH``); the victim node id sits in payload slot
-    ``node_slot``. Returns ``(time_ns, action, node)`` in dispatch order.
-    """
-    t = np.asarray(trace["time_ns"])
+    ``trace`` is the dict returned by ``engine.run_traced``; ``fault_kind``
+    is the workload's unified fault event kind (e.g. ``models.raft.
+    K_FAULT``). Fault payloads are ``(action, victim, t_lo, t_hi)``
+    (engine/faults.compile_device), so the returned times are the *exact
+    scheduled deadlines* — free of the engine's 50-100 ns dispatch
+    jitter — and compare equal to ``madsim_tpu.faults.compile_host`` for
+    the same ``(spec, seed)``, PROVIDED every scheduled event fits the
+    engine horizon: only events that actually fired appear in a trace, so
+    a fault drawn at or past ``time_limit_ns`` (or beyond ``max_steps``)
+    is absent here while ``compile_host`` still lists it. Size campaign
+    windows (plus the max restart/heal delay) inside the horizon when the
+    full environment must transfer. Returns ``(time_ns, action, victim)``
+    sorted by time."""
+    from .engine.faults import ACTION_NAMES, decode_time
+
     k = np.asarray(trace["kind"])
     p = np.asarray(trace["pay"])
     fired = np.asarray(trace["fired"])
     plan: List[FaultEvent] = []
-    for i in np.nonzero(fired)[0]:
-        if k[i] == crash_kind:
-            plan.append((int(t[i]), "crash", int(p[i, node_slot])))
-        elif k[i] == restart_kind:
-            plan.append((int(t[i]), "restart", int(p[i, node_slot])))
-    return plan
+    for i in np.nonzero(fired & (k == fault_kind))[0]:
+        t = int(decode_time(p[i, 2], p[i, 3]))
+        plan.append((t, ACTION_NAMES[int(p[i, 0])], int(p[i, 1])))
+    return sorted(plan)
 
 
 def replay_on_host(

@@ -17,6 +17,15 @@ accepted and ignored (the sim trusts its caller, like the reference
 sim). XML parsing uses the stdlib ElementTree; this server is a test
 double, not an internet-facing endpoint.
 
+Scope: listing is **ListObjectsV2 only** — ``GET /bucket`` without
+``list-type=2`` (ListObjects v1, the default for several stock SDK code
+paths) is rejected with ``InvalidArgument`` rather than served with
+Marker/NextMarker pagination; configure clients for v2 listing. Ranged
+reads are **not supported** either: ``GetObject`` ignores a ``Range``
+header and always returns the full body with 200 (no 206/Content-Range).
+Both are deliberate test-double boundaries, not oversights (README
+"ecosystem shims" scope note).
+
 Operation map (path-style):
   PUT    /bucket                         CreateBucket
   DELETE /bucket                         DeleteBucket

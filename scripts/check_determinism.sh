@@ -1,0 +1,73 @@
+#!/usr/bin/env bash
+# Determinism smoke gate (wired into `make stest`, see docs/faults.md):
+# run a small fault-campaign sweep + traced replays twice with the same
+# seeds, in two SEPARATE processes (fresh jit caches, fresh process
+# state), and byte-diff the dumped traces. Any drift in the schedule
+# derivation, the engine loop, or the fault interpreter fails the gate.
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+out="$(mktemp -d)"
+trap 'rm -rf "$out"' EXIT
+
+dump() {
+  "${PY:-python}" - "$1" <<'EOF'
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.engine import core
+from madsim_tpu.engine.faults import FaultSpec
+from madsim_tpu.models import raft
+
+spec = FaultSpec(
+    crashes=2, crash_window_ns=1_500_000_000,
+    partitions=2, part_window_ns=1_500_000_000,
+    spikes=1, losses=1, pauses=1,
+)
+cfg = raft.RaftConfig(num_nodes=4, commands=4, faults=spec)
+ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+wl = raft.workload(cfg)
+
+blobs = {}
+# a small sweep: every per-seed counter and latched flag
+final = core.run_sweep(wl, ecfg, jnp.arange(256, dtype=jnp.int64))
+for i, leaf in enumerate(jax.tree.leaves(final)):
+    if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+        leaf = jax.random.key_data(leaf)
+    blobs[f"sweep.{i}"] = np.asarray(leaf)
+# two traced replays: the full dispatched event schedule, byte for byte
+for seed in (0, 7):
+    _, trace = core.run_traced(wl, ecfg, seed)
+    for k in sorted(trace):
+        blobs[f"trace{seed}.{k}"] = np.asarray(trace[k])
+np.savez(sys.argv[1], **blobs)
+print(f"wrote {len(blobs)} arrays -> {sys.argv[1]}")
+EOF
+}
+
+dump "$out/a.npz"
+dump "$out/b.npz"
+
+# npz member timestamps are zeroed by numpy, so the archives themselves
+# must be byte-identical when every array is
+if cmp -s "$out/a.npz" "$out/b.npz"; then
+  echo "determinism gate: OK (two processes, byte-identical traces)"
+else
+  echo "determinism gate: FAILED — traces differ between identical runs" >&2
+  "${PY:-python}" - "$out/a.npz" "$out/b.npz" <<'EOF' >&2
+import sys
+
+import numpy as np
+
+a, b = (np.load(p) for p in sys.argv[1:3])
+for k in sorted(set(a.files) | set(b.files)):
+    if k not in a.files or k not in b.files:
+        print(f"  {k}: only in one run")
+    elif not np.array_equal(a[k], b[k]):
+        print(f"  {k}: differs")
+EOF
+  exit 1
+fi

@@ -71,10 +71,10 @@ def main() -> None:
         name = kind_names.get(int(kinds[i]), str(int(kinds[i])))
         print(f"  t={times[i] / 1e9:9.6f}s {name:<9} pay={[int(x) for x in pays[i][:4]]}")
 
-    plan = replay.extract_fault_plan(trace, raft.K_CRASH, raft.K_RESTART)
-    print(f"--- fault plan ({len(plan)} events) ---")
+    plan = replay.extract_fault_schedule(trace, raft.K_FAULT)
+    print(f"--- fault schedule ({len(plan)} events) ---")
     for t, action, node in plan:
-        print(f"  t={t / 1e9:9.6f}s {action:<7} node={node}")
+        print(f"  t={t / 1e9:9.6f}s {action:<9} node={node}")
 
     if not plan:
         print("no faults in this seed's schedule; nothing to replay on host")

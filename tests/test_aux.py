@@ -265,3 +265,49 @@ def test_resumable_chunked_sweep(tmp_path, monkeypatch):
         )
         == totals
     )
+
+
+def test_resumable_sweep_survives_layout_only_config_changes(
+    tmp_path, monkeypatch
+):
+    """legacy_queue (and cond_interval) select equivalent layouts whose
+    schedules are bit-identical (test_engine.py::
+    test_legacy_queue_layout_bit_identical), so a checkpoint directory
+    written under one layout must resume — all chunks from disk, zero
+    device work — under the other."""
+    import madsim_tpu.engine.core as ecore_mod
+
+    cfg = raft.RaftConfig(num_nodes=3, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=500_000_000, max_steps=4_000)
+    wl = raft.workload(cfg)
+    seeds = jnp.arange(8, dtype=jnp.int64)
+    d = str(tmp_path / "ckpts")
+
+    totals = checkpoint.run_sweep_chunked_resumable(
+        wl, ecfg, seeds, raft.sweep_summary, d, chunk_size=8
+    )
+
+    def boom(*a, **k):
+        raise AssertionError("layout-only change must not re-run the sweep")
+
+    monkeypatch.setattr(ecore_mod, "run_sweep", boom)
+    for other in (
+        ecfg._replace(legacy_queue=1),
+        ecfg._replace(cond_interval=32),
+    ):
+        resumed = checkpoint.run_sweep_chunked_resumable(
+            wl, other, seeds, raft.sweep_summary, d, chunk_size=8
+        )
+        assert resumed == totals
+    monkeypatch.undo()
+
+    # a SEMANTIC config change must still be refused
+    with pytest.raises(ValueError, match="different sweep"):
+        checkpoint.run_sweep_chunked_resumable(
+            wl,
+            ecfg._replace(time_limit_ns=900_000_000),
+            seeds,
+            raft.sweep_summary,
+            d,
+            chunk_size=8,
+        )

@@ -80,6 +80,19 @@ def test_bounded_range():
     assert int(vals.min()) >= 10 and int(vals.max()) < 20
 
 
+def test_bounded_wide_spans_do_not_sign_wrap():
+    """spans above 2**31 (5 s fault windows, day-scale spans) used to
+    overflow the int64 product and wrap times negative; the half-width
+    multiply must stay in range AND match exact integer arithmetic."""
+    k = seed_key(jnp.int64(2))
+    draws = event_bits(k, jnp.int32(0), 256)
+    for lo, hi in ((0, 5_000_000_000), (0, 1 << 47), (-3, 4_000_000_000)):
+        vals = bounded(draws, lo, hi)
+        assert int(vals.min()) >= lo and int(vals.max()) < hi
+        expect = [lo + ((int(d) * (hi - lo)) >> 32) for d in draws]
+        assert [int(v) for v in vals] == expect
+
+
 def test_coin_fixed_point():
     assert not bool(coin(jnp.uint32(0xFFFFFFFF), jnp.uint32(prob_to_q32(0.5))))
     assert bool(coin(jnp.uint32(0), jnp.uint32(prob_to_q32(0.001))))
@@ -421,6 +434,9 @@ def test_cmd_retry_cap_and_giveups_surfaced():
     cfg = raft.RaftConfig(
         num_nodes=3, crashes=0, commands=4, loss_q32=prob_to_q32(1.0),
         cmd_max_retries=5, cmd_retry_ns=10_000_000,
+        # every command must fire AND exhaust its retries inside the
+        # 2 s time limit, or it can neither accept nor give up
+        cmd_window_ns=1_000_000_000,
     )
     ecfg = raft.engine_config(cfg, time_limit_ns=2_000_000_000, max_steps=50_000)
     final = ecore.run_sweep(raft.workload(cfg), ecfg, jnp.arange(8, dtype=jnp.int64))

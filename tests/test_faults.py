@@ -1,0 +1,395 @@
+"""Unified cross-tier fault campaigns (engine/faults.py + madsim_tpu/faults.py).
+
+The contract under test: ONE ``FaultSpec`` compiles to the IDENTICAL
+``(time_ns, action, victim)`` schedule on both tiers — the device tier
+injects it into a lockstep sweep's event queues, the host tier applies it
+to live nodes via ``Handle.kill/restart`` and the ``NetSim`` fault
+surface — and the shared in-loop interpreter (``FaultState`` +
+``on_event``) composes overlapping windows exactly.
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/examples")
+
+import raft_host
+
+import madsim_tpu as ms
+from madsim_tpu import faults as hfaults
+from madsim_tpu import replay
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine import faults as efaults
+from madsim_tpu.engine import net as enet
+from madsim_tpu.models import etcd, kafka, raft
+
+# every category enabled, all windows well inside the sim horizon
+FULL_SPEC = efaults.FaultSpec(
+    crashes=2,
+    crash_window_ns=1_500_000_000,
+    restart_lo_ns=100_000_000,
+    restart_hi_ns=400_000_000,
+    partitions=2,
+    part_window_ns=1_500_000_000,
+    part_lo_ns=200_000_000,
+    part_hi_ns=600_000_000,
+    spikes=1,
+    spike_window_ns=1_500_000_000,
+    spike_dur_lo_ns=200_000_000,
+    spike_dur_hi_ns=500_000_000,
+    losses=1,
+    loss_window_ns=1_500_000_000,
+    loss_dur_lo_ns=200_000_000,
+    loss_dur_hi_ns=500_000_000,
+    pauses=1,
+    pause_window_ns=1_500_000_000,
+    pause_lo_ns=100_000_000,
+    pause_hi_ns=300_000_000,
+)
+
+
+# -- the differential: device schedule == host schedule ----------------------
+
+
+def test_device_and_host_compile_identical_schedules():
+    """The acceptance gate: for one (spec, seed), the fault events a
+    device-tier raft sweep actually dispatches (recovered from a traced
+    replay, exact scheduled deadlines from the payloads) are byte-equal
+    to the host compiler's schedule — through the engine's queue, vmap
+    dispatch, and payload round-trip."""
+    cfg = raft.RaftConfig(num_nodes=4, commands=0, faults=FULL_SPEC)
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    wl = raft.workload(cfg)
+    for seed in (0, 7, 93):
+        _, trace = ecore.run_traced(wl, ecfg, seed)
+        device = replay.extract_fault_schedule(trace, raft.K_FAULT)
+        host = hfaults.compile_host(FULL_SPEC, cfg.num_nodes, seed)
+        assert device == host, (seed, device, host)
+        assert len(device) == efaults.num_events(FULL_SPEC)
+
+
+def test_kafka_and_etcd_share_the_same_compiler():
+    """The schedule is model-independent: for the same (spec, seed, N)
+    the kafka and etcd workloads inject the identical schedule."""
+    spec = FULL_SPEC._replace(crash_group=(0, 1), part_group=(1, -1))
+    kcfg = kafka.KafkaConfig(num_producers=1, num_consumers=1, faults=spec)
+    eccfg = etcd.EtcdConfig(num_clients=2, faults=spec)
+    assert kcfg.num_nodes == eccfg.num_nodes == 3
+    kecfg = kafka.engine_config(kcfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    eecfg = etcd.engine_config(eccfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    seed = 5
+    _, kt = ecore.run_traced(kafka.workload(kcfg), kecfg, seed)
+    _, et = ecore.run_traced(etcd.workload(eccfg), eecfg, seed)
+    ks = replay.extract_fault_schedule(kt, kafka.K_FAULT)
+    es = replay.extract_fault_schedule(et, etcd.K_FAULT)
+    host = hfaults.compile_host(spec, 3, seed)
+    assert ks == es == host
+
+
+def test_schedule_respects_windows_and_groups():
+    spec = FULL_SPEC._replace(crash_group=(1, 3), part_group=(0, 2))
+    for seed in range(16):
+        sched = hfaults.compile_host(spec, 4, seed)
+        by_action = {}
+        for t, action, v in sched:
+            by_action.setdefault(action, []).append((t, v))
+        for on, off, window, group in (
+            ("crash", "restart", spec.crash_window_ns, (1, 3)),
+            ("partition", "heal", spec.part_window_ns, (0, 2)),
+            ("pause", "resume", spec.pause_window_ns, (0, 4)),
+        ):
+            assert len(by_action[on]) == len(by_action[off])
+            for t, v in by_action[on]:
+                assert 0 <= t < window
+                assert group[0] <= v < group[1]
+        # bursts are network-wide: victim is always 0
+        assert all(v == 0 for _, v in by_action["spike_on"])
+        assert all(v == 0 for _, v in by_action["loss_on"])
+
+
+def test_compile_host_is_deterministic_and_seed_sensitive():
+    a = hfaults.compile_host(FULL_SPEC, 4, 42)
+    b = hfaults.compile_host(FULL_SPEC, 4, 42)
+    c = hfaults.compile_host(FULL_SPEC, 4, 43)
+    assert a == b
+    assert a != c
+
+
+# -- the shared in-loop interpreter ------------------------------------------
+
+
+def _apply(spec, base, links, f, action, victim):
+    links, f, _edges = efaults.on_event(
+        spec, base, links, f, jnp.int32(action), jnp.int32(victim)
+    )
+    return links, f
+
+
+def test_partition_refcount_composes():
+    """Overlapping partition windows of one victim: the first heal must
+    not reopen the second window's clog."""
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_PART, 1)
+    assert bool(links.clog[1, 0]) and bool(links.clog[0, 1])
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_PART, 1)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_HEAL, 1)
+    assert bool(links.clog[1, 0]), "still inside the second window"
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_HEAL, 1)
+    assert not bool(links.clog.any())
+    assert int(f.part_cnt[1]) == 0
+
+
+def test_burst_overrides_and_restores_base_values():
+    base = efaults.NetBase(1_000_000, 10_000_000, 7)
+    links = enet.make(3, loss_q32=7)
+    f = efaults.init_state(3)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_SPIKE_ON, 0)
+    assert int(links.lat_lo_ns) == FULL_SPEC.spike_lat_lo_ns
+    assert int(links.lat_hi_ns) == FULL_SPEC.spike_lat_hi_ns
+    # nested burst: the inner off must not restore early
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_SPIKE_ON, 0)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_SPIKE_OFF, 0)
+    assert int(links.lat_lo_ns) == FULL_SPEC.spike_lat_lo_ns
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_SPIKE_OFF, 0)
+    assert int(links.lat_lo_ns) == base.lat_lo_ns
+    assert int(links.lat_hi_ns) == base.lat_hi_ns
+    # loss burst the same way
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_LOSS_ON, 0)
+    assert int(links.loss_q32) == FULL_SPEC.burst_loss_q32
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_LOSS_OFF, 0)
+    assert int(links.loss_q32) == base.loss_q32
+
+
+def test_crash_and_pause_masks():
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_CRASH, 2)
+    assert not bool(f.alive[2]) and bool(f.alive[0])
+    assert not bool(efaults.up(f)[2])
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_RESTART, 2)
+    assert bool(efaults.up(f)[2])
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_PAUSE, 0)
+    assert bool(f.alive[0]) and not bool(efaults.up(f)[0])
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_RESUME, 0)
+    assert bool(efaults.up(f)[0])
+
+
+def test_crash_pause_interaction_matches_host_supervisor():
+    """Overlapping crash and pause windows on one victim must resolve the
+    way apply_schedule does: a kill clears the pause (the node's tasks
+    are gone — restart revives it RUNNING), and pausing/resuming a dead
+    node is a no-op."""
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    # pause(1), crash(1), restart(1): the restarted node must be up even
+    # though its resume has not fired yet
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_PAUSE, 1)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_CRASH, 1)
+    assert not bool(f.paused[1]), "kill clears the pause"
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_RESTART, 1)
+    assert bool(efaults.up(f)[1]), "restarted node revives running"
+    # the stale resume is now a harmless no-op
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_RESUME, 1)
+    assert bool(efaults.up(f)[1])
+    # pausing a dead node is a no-op: after restart it is up
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_CRASH, 2)
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_PAUSE, 2)
+    assert not bool(f.paused[2])
+    links, f = _apply(FULL_SPEC, base, links, f, efaults.F_RESTART, 2)
+    assert bool(efaults.up(f)[2])
+
+
+def test_resumed_raft_leader_rearms_heartbeats():
+    """A paused-then-resumed LEADER keeps its role, so resume must re-arm
+    its heartbeat chain (the pause's lepoch bump killed it) — the host
+    tier's Handle.resume lets the leader's tasks heartbeat on, and a
+    leader with neither timer chain would sit mute until deposed."""
+    cfg = raft.RaftConfig(num_nodes=3, commands=0)
+    wl = raft.workload(cfg)
+    w, _ = wl.init(jax.random.key(0))
+    w = w._replace(
+        role=w.role.at[0].set(2),  # LEADER
+        fstate=w.fstate._replace(paused=w.fstate.paused.at[0].set(True)),
+    )
+    rand = jnp.zeros((wl.num_rand,), jnp.uint32)
+    pay = jnp.zeros((wl.payload_slots,), jnp.int32)
+    pay = pay.at[0].set(efaults.F_RESUME)  # victim defaults to node 0
+    w2, emits = wl.handle(w, jnp.int64(1_000), jnp.int32(raft.K_FAULT), pay, rand)
+    assert bool(efaults.up(w2.fstate)[0])
+    fired = {
+        int(k)
+        for k, en in zip(np.asarray(emits.kinds), np.asarray(emits.enables))
+        if en
+    }
+    assert raft.K_HEARTBEAT in fired, "resumed leader must re-enter heartbeats"
+    assert raft.K_ELECTION not in fired, "leaders never hold election timers"
+    # a resumed non-leader re-enters the election chain instead
+    w3 = w._replace(role=w.role.at[0].set(0))
+    _, emits2 = wl.handle(w3, jnp.int64(1_000), jnp.int32(raft.K_FAULT), pay, rand)
+    fired2 = {
+        int(k)
+        for k, en in zip(np.asarray(emits2.kinds), np.asarray(emits2.enables))
+        if en
+    }
+    assert raft.K_ELECTION in fired2 and raft.K_HEARTBEAT not in fired2
+
+
+def test_group_validation():
+    import pytest
+
+    with pytest.raises(ValueError, match="group"):
+        efaults.schedule_events(
+            efaults.FaultSpec(crashes=1, crash_group=(3, 2)), 4,
+            jax.random.key(0),
+        )
+    with pytest.raises(ValueError, match="payload slots"):
+        efaults.compile_device(
+            efaults.FaultSpec(crashes=1), 3, jax.random.key(0), 3, 2
+        )
+
+
+# -- full campaigns through the sweep engine ---------------------------------
+
+
+def test_raft_campaign_sweep_stays_safe_and_deterministic():
+    """A full campaign (crashes + partitions + bursts + pauses) over a
+    raft sweep: checkers stay quiet, faults demonstrably perturb
+    schedules, and traced replay parity holds."""
+    base_cfg = raft.RaftConfig(num_nodes=4, commands=4, crashes=0)
+    cfg = base_cfg._replace(faults=FULL_SPEC)
+    ecfg = raft.engine_config(
+        cfg, queue_capacity=160, time_limit_ns=3_000_000_000, max_steps=30_000
+    )
+    seeds = jnp.arange(48, dtype=jnp.int64)
+    quiet = ecore.run_sweep(
+        raft.workload(base_cfg._replace(faults=efaults.FaultSpec())), ecfg, seeds
+    )
+    stormy = ecore.run_sweep(raft.workload(cfg), ecfg, seeds)
+    s = raft.sweep_summary(stormy)
+    assert s["violations"] == 0, s
+    assert s["overflow_seeds"] == 0
+    frac_changed = np.mean(np.asarray(quiet.ctr) != np.asarray(stormy.ctr))
+    assert frac_changed > 0.5, frac_changed
+    single, _ = ecore.run_traced(raft.workload(cfg), ecfg, 11)
+    assert int(single.ctr) == int(stormy.ctr[11])
+
+
+def test_one_spec_drives_both_tiers_end_to_end():
+    """The acceptance scenario: ONE FaultSpec instance drives a device
+    raft sweep (finding amnesia violations) AND a host-tier raft run
+    under the same compiled schedule — which reproduces the violation."""
+    spec = efaults.FaultSpec(
+        crashes=3,
+        crash_window_ns=2_000_000_000,
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=300_000_000,
+    )
+    cfg = raft.RaftConfig(
+        num_nodes=3, commands=0, volatile_state=True, faults=spec
+    )
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    final = ecore.run_sweep(
+        raft.workload(cfg), ecfg, jnp.arange(160, dtype=jnp.int64)
+    )
+    vio = replay.violation_seeds(final)
+    assert vio.size > 0, "amnesia campaign found no violations"
+    # the host tier explores its own schedules under the compiled fault
+    # environment, so scan a few violation seeds x host seeds (exactly
+    # like the trace-driven pipeline in tests/test_replay.py)
+    result = None
+    for campaign_seed in vio[:4]:
+        result = replay.replay_on_host(
+            lambda hs, _p: raft_host.run_seed_with_spec(
+                hs, spec, int(campaign_seed), n=cfg.num_nodes, sim_seconds=3.0
+            ),
+            plan=[],  # unused: the spec compiles the schedule directly
+            host_seeds=range(10),
+        )
+        if result is not None:
+            break
+    assert result is not None, "violation did not reproduce on the host tier"
+    assert result["violations"] > 0
+
+
+def test_host_supervisor_applies_partitions_and_bursts():
+    """apply_schedule drives the NetSim fault surface: partitions clog
+    and heal with refcounts, bursts override and restore the config, and
+    pause/resume edge-gate correctly."""
+    from madsim_tpu.net import NetSim
+
+    spec = FULL_SPEC
+    schedule = [
+        (100_000_000, "partition", 1),
+        (150_000_000, "partition", 1),
+        (200_000_000, "spike_on", 0),
+        (250_000_000, "loss_on", 0),
+        (300_000_000, "heal", 1),
+        (400_000_000, "spike_off", 0),
+        (450_000_000, "loss_off", 0),
+        (500_000_000, "heal", 1),
+        (600_000_000, "pause", 0),
+        (700_000_000, "resume", 0),
+        (800_000_000, "crash", 1),
+        (900_000_000, "restart", 1),
+    ]
+    observed = {}
+
+    async def main():
+        h = ms.current_handle()
+        ns = h.simulator(NetSim)
+        nodes = [h.create_node().name(f"n{i}").build() for i in range(2)]
+        base_latency = ns.config.net.send_latency
+
+        async def probe():
+            await ms.sleep(0.35)  # inside partition #2 + both bursts
+            observed["clogged_mid"] = ns.network.is_clogged(
+                nodes[1].id, nodes[0].id
+            )
+            observed["lat_mid"] = ns.config.net.send_latency
+            observed["loss_mid"] = ns.config.net.packet_loss_rate
+
+        ms.spawn(probe())
+        await hfaults.apply_schedule(schedule, nodes, spec=spec)
+        observed["clogged_end"] = ns.network.is_clogged(nodes[1].id, nodes[0].id)
+        observed["lat_end"] = ns.config.net.send_latency
+        observed["loss_end"] = ns.config.net.packet_loss_rate
+        observed["base_latency"] = base_latency
+
+    ms.Runtime(seed=1).block_on(main())
+    assert observed["clogged_mid"], "heal #1 must not reopen window #2"
+    assert observed["lat_mid"] == (
+        spec.spike_lat_lo_ns / 1e9,
+        spec.spike_lat_hi_ns / 1e9,
+    )
+    assert observed["loss_mid"] == spec.burst_loss_q32 / 2**32
+    assert not observed["clogged_end"]
+    assert observed["lat_end"] == observed["base_latency"]
+    assert observed["loss_end"] == 0.0
+
+
+def test_etcd_campaign_server_crash_gates_processing():
+    """Beyond the legacy partition-only etcd faults: a server-crash
+    campaign compiles for the etcd model too — requests sent into the
+    crash window go unanswered, the run stays violation-free."""
+    spec = efaults.FaultSpec(
+        crashes=1,
+        crash_window_ns=1_000_000_000,
+        restart_lo_ns=200_000_000,
+        restart_hi_ns=600_000_000,
+        crash_group=(0, 1),
+    )
+    cfg = etcd.EtcdConfig(faults=spec)
+    ecfg = etcd.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    final = ecore.run_sweep(etcd.workload(cfg), ecfg, jnp.arange(32, dtype=jnp.int64))
+    s = etcd.sweep_summary(final)
+    assert s["violations"] == 0, s
+    assert s["puts"] > 0 and s["gets"] > 0
+    # requests outnumber replies: the dead-server window swallowed some
+    assert s["msgs_sent"] > s["msgs_delivered"]

@@ -468,6 +468,49 @@ def test_group_rebalance_commits_consumed_before_revoke():
     with_broker(904, run)
 
 
+def test_group_commit_generation_fencing():
+    """A zombie member — holding an assignment a rebalance it never
+    observed has revoked — cannot roll the group's committed offsets
+    backward: the broker rejects its stale-generation commit with
+    ILLEGAL_GENERATION, and the new owner's commit survives."""
+
+    async def run():
+        admin = await cfg().create(AdminClient)
+        await admin.create_topics([NewTopic.new("g8", 1)])
+        producer = await cfg().create(FutureProducer)
+        for i in range(6):
+            await producer.send(BaseRecord.to("g8").with_payload(f"m{i}"))
+
+        zombie = await gcfg("grp8", auto=False).create(BaseConsumer)
+        await zombie.subscribe(["g8"])  # generation 1, owns the partition
+        for _ in range(3):
+            m = await zombie.poll(timeout_s=0.5)
+            assert m is not None
+        await zombie.commit()  # current generation: accepted (offset 3)
+
+        other = await gcfg("grp8", auto=False).create(BaseConsumer)
+        await other.subscribe(["g8"])  # rebalance: generation 2
+
+        # the zombie — which never observed generation 2 — may not
+        # commit: unfenced, a delayed/stale commit here could roll the
+        # offset backward past a newer owner's progress
+        with pytest.raises(KafkaError, match="ILLEGAL_GENERATION"):
+            await zombie.commit()
+        tpl = TopicPartitionList().add_partition("g8", 0)
+        committed = await other.committed(tpl)
+        assert committed[0][2] == 3  # the fenced commit changed nothing
+
+        # once the member observes the current generation (an empty poll
+        # heartbeats and adopts it), its commits are accepted again
+        while await zombie.poll(timeout_s=0.3) is not None:
+            pass
+        await zombie.commit()
+        committed = await other.committed(tpl)
+        assert committed[0][2] == 6  # all six consumed + committed at gen 2
+
+    with_broker(906, run)
+
+
 def test_group_ops_on_unknown_group_error():
     """commit/committed/heartbeat against a group nobody ever joined must
     error by name, not silently materialize an empty group."""

@@ -104,6 +104,44 @@ def test_real_signal_wakes_all_concurrent_waiters():
     assert os_signal.getsignal(os_signal.SIGINT) is os_signal.default_int_handler
 
 
+def test_real_signal_dead_waiter_cannot_strand_live_waiters():
+    """A waiter future bound to a closed loop (its Runtime was abandoned
+    without cancellation) must not break _on_sigint for the remaining
+    live waiters (ADVICE.md finding): the dead future is skipped, every
+    live waiter still wakes."""
+    import asyncio
+
+    from madsim_tpu.real import signal as rsignal
+
+    # fabricate the dead waiter: a future from a loop that is now closed
+    dead_loop = asyncio.new_event_loop()
+    dead_fut = dead_loop.create_future()
+    dead_loop.close()
+    rsignal._waiters.append(dead_fut)
+    try:
+
+        async def main():
+            woke = []
+
+            async def waiter(tag):
+                await real.signal.ctrl_c()
+                woke.append(tag)
+
+            t1 = real.spawn(waiter("a"))
+            t2 = real.spawn(waiter("b"))
+            await real.sleep(0.05)
+            os.kill(os.getpid(), os_signal.SIGINT)
+            await real.timeout(5.0, t1)
+            await real.timeout(5.0, t2)
+            assert sorted(woke) == ["a", "b"]
+
+        real.Runtime().block_on(main())
+        assert not dead_fut.done()  # skipped, not resolved
+    finally:
+        if dead_fut in rsignal._waiters:
+            rsignal._waiters.remove(dead_fut)
+
+
 def test_tokio_process_command_surface():
     """tokio::process::Command analogue over real subprocesses."""
 

@@ -39,13 +39,18 @@ def test_sweep_to_host_replay_end_to_end():
     single, trace = ecore.run_traced(raft.workload(CFG), ECFG, seed)
     assert bool(single.wstate.violation)
 
-    # 3. the recorded fault plan is well-formed
-    plan = replay.extract_fault_plan(trace, raft.K_CRASH, raft.K_RESTART)
+    # 3. the recorded fault schedule is well-formed — and identical to
+    # what compiling the spec directly yields (the trace hop adds no
+    # drift: exact deadlines survive the payload round-trip)
+    plan = replay.extract_fault_schedule(trace, raft.K_FAULT)
     assert len(plan) == 2 * CFG.crashes
-    times = [t for t, _, _ in plan]
-    assert times == sorted(times)
     assert {a for _, a, _ in plan} == {"crash", "restart"}
     assert all(0 <= node < CFG.num_nodes for _, _, node in plan)
+    from madsim_tpu import faults as hfaults
+
+    assert plan == hfaults.compile_host(
+        raft.fault_spec(CFG), CFG.num_nodes, seed
+    )
 
     # 4. the same fault schedule breaks the host-tier user code: the
     # supervisor kills/restarts at the recorded virtual times and the
@@ -65,8 +70,8 @@ def test_fault_plan_extraction_is_deterministic():
     seed = 93
     _, t1 = ecore.run_traced(raft.workload(CFG), ECFG, seed)
     _, t2 = ecore.run_traced(raft.workload(CFG), ECFG, seed)
-    p1 = replay.extract_fault_plan(t1, raft.K_CRASH, raft.K_RESTART)
-    p2 = replay.extract_fault_plan(t2, raft.K_CRASH, raft.K_RESTART)
+    p1 = replay.extract_fault_schedule(t1, raft.K_FAULT)
+    p2 = replay.extract_fault_schedule(t2, raft.K_FAULT)
     assert p1 == p2 and len(p1) == 2 * CFG.crashes
 
 
