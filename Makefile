@@ -7,8 +7,13 @@
 #   make test-real     real-mode legs only (asyncio + real sockets + grpcio
 #                      wire + real fs/signal/process)
 #   make test-procs    forked-process sweep smoke (fail-fast, jax guard)
+#   make explore-smoke the explore pipeline end to end on a tiny budget
+#                      (CPU backend, fixed campaign seed: find -> triage
+#                      -> shrink against the amnesia raft target)
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
-#                      sweep twice in two processes, traces byte-diffed)
+#                      sweep twice in two processes, traces byte-diffed;
+#                      plus two campaign runs, JSONL reports byte-diffed)
+#                      + explore-smoke
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality
 #   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
@@ -21,8 +26,8 @@ PY ?= python
 PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
-.PHONY: test test-nonative test-real test-procs stest determinism dryrun \
-	bench-smoke test-all
+.PHONY: test test-nonative test-real test-procs stest determinism \
+	explore-smoke dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -30,7 +35,14 @@ test:
 determinism:
 	PY=$(PY) bash scripts/check_determinism.sh
 
-stest: test determinism
+# campaign seed 5 on purpose: tests/test_explore.py already runs the
+# seed-1 campaign, so the gate explores a second mutation path instead
+# of paying ~70 s to repeat the same deterministic computation
+explore-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/explore_demo.py \
+	  --rounds 6 --seeds-per-round 128 --campaign-seed 5
+
+stest: test determinism explore-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

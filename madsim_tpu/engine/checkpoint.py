@@ -20,7 +20,9 @@ from .core import EngineConfig, EngineState, Workload
 # v2: EngineState gained qmax; draw layout adds tie-break.
 # v3: packed queue layout — the redundant bool valid[Q] plane left the
 #     EventQueue, so v2 files would load positionally misaligned.
-_FORMAT_VERSION = 3
+# v4: EngineState gained the per-seed coverage bitmap (``cover``), so v3
+#     files would load positionally misaligned.
+_FORMAT_VERSION = 4
 
 
 def save_sweep(state: EngineState, path: str) -> None:
@@ -180,11 +182,17 @@ def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
     sweep's stale-checkpoint guard. Model configs are NamedTuples of
     plain values, so their repr is a stable fingerprint. Layout-only
     engine fields (``_LAYOUT_ONLY_FIELDS``) are excluded: they cannot
-    change a chunk's summary, only its wall-clock."""
+    change a chunk's summary, only its wall-clock. ``cover_bits`` is
+    INCLUDED: it changes the summary schema (``coverage_map`` appears),
+    so chunk summaries written by a coverage-free workload must not
+    silently merge into a coverage-guided sweep as zero coverage."""
     init = workload.init
     fn = getattr(init, "func", init)
     args = getattr(init, "args", ())
     cfg_id = tuple(
         v for f, v in zip(cfg._fields, cfg) if f not in _LAYOUT_ONLY_FIELDS
     )
-    return f"{fn.__module__}.{fn.__qualname__}|{args!r}|{cfg_id!r}"
+    return (
+        f"{fn.__module__}.{fn.__qualname__}|{args!r}|{cfg_id!r}"
+        f"|cover{workload.cover_bits}"
+    )

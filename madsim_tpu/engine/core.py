@@ -31,7 +31,7 @@ simulation semantics.
 from __future__ import annotations
 
 from functools import partial
-from typing import Any, Callable, NamedTuple, Tuple
+from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -73,6 +73,23 @@ class Workload(NamedTuple):
     num_rand: int
     payload_slots: int
     max_emits: int
+    # Optional coverage signal (madsim_tpu/explore): ``cover(wstate_before,
+    # wstate_after, now_ns, kind, pay) -> int32`` maps each dispatched
+    # event to one bit index in ``[0, cover_bits)`` — typically
+    # (event kind x node x state transition). The engine ORs the bit into
+    # the per-seed bitmap inside the same step (one extra masked write,
+    # no second pass); ``cover_bits == 0`` disables the plane entirely.
+    cover: Optional[Callable[..., jnp.ndarray]] = None
+    cover_bits: int = 0
+    # Optional violation probe: ``probe(wstate) -> int32`` flavor bitmask
+    # (0 = no violation). ``run_traced`` records it per step so triage
+    # (explore/triage.py) can locate the FIRST violating event.
+    probe: Optional[Callable[[Any], jnp.ndarray]] = None
+
+
+def cover_words(workload: Workload) -> int:
+    """uint32 words of the per-seed coverage bitmap (0 when disabled)."""
+    return (workload.cover_bits + 31) // 32
 
 
 class EngineConfig(NamedTuple):
@@ -110,6 +127,7 @@ class EngineState(NamedTuple):
     done: jnp.ndarray  # bool
     overflow: jnp.ndarray  # bool sticky queue-overflow flag
     qmax: jnp.ndarray  # int32 queue-occupancy high-water mark
+    cover: jnp.ndarray  # uint32[cover_words] per-seed coverage bitmap
     queue: EventQueue
     wstate: Any  # workload pytree
 
@@ -143,6 +161,7 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
         done=jnp.zeros((), bool),
         overflow=overflow,
         qmax=equeue.size(q),
+        cover=jnp.zeros((cover_words(workload),), jnp.uint32),
         queue=q,
         wstate=wstate,
     )
@@ -204,6 +223,20 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         q, emits.times, emits.kinds, emits.pays, emits.enables & take
     )
 
+    # coverage: fold this event's bit into the per-seed bitmap — a masked
+    # OR in the same step, so the signal costs one extra [W]-sized write,
+    # never a second pass over the sweep
+    cover = s.cover
+    if workload.cover is not None and workload.cover_bits > 0:
+        w = cover_words(workload)
+        bit = jnp.asarray(
+            workload.cover(s.wstate, wstate, now, kind, pay), jnp.uint32
+        )
+        hit = (jnp.arange(w, dtype=jnp.uint32) == (bit >> 5)) & take
+        cover = cover | jnp.where(
+            hit, jnp.uint32(1) << (bit & 31), jnp.uint32(0)
+        )
+
     def sel(pred, new, old):
         return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
 
@@ -215,6 +248,7 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         done=s.done | (active & (~found | time_up)),
         overflow=s.overflow | (take & ov),
         qmax=jnp.maximum(s.qmax, equeue.size(q)),
+        cover=cover,
         queue=q,
         wstate=sel(take, wstate, s.wstate),
     )
@@ -356,18 +390,30 @@ def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray):
         _, q, t, kind, pay, found = _pop_event(workload, s, jnp.zeros((), bool))
         s2 = step_one(workload, cfg, s)
         fired = s2.ctr > before_ctr
+        # probe AFTER the step: entry i is the violation-flavor bitmask
+        # once event i has been applied, so the first i where it becomes
+        # nonzero is the first violating event (explore/triage.py)
+        probe = (
+            jnp.asarray(workload.probe(s2.wstate), jnp.int32)
+            if workload.probe is not None
+            else jnp.zeros((), jnp.int32)
+        )
         rec = (
             jnp.where(fired, s2.now_ns, jnp.int64(-1)),
             jnp.where(fired, kind, jnp.int32(-1)),
             jnp.where(fired, pay, jnp.zeros_like(pay)),
             fired,
+            probe,
         )
         return s2, rec
 
-    final, (times, kinds, pays, fired) = jax.lax.scan(
+    final, (times, kinds, pays, fired, probes) = jax.lax.scan(
         scan_step, state, None, length=cfg.max_steps
     )
-    return final, {"time_ns": times, "kind": kinds, "pay": pays, "fired": fired}
+    trace = {"time_ns": times, "kind": kinds, "pay": pays, "fired": fired}
+    if workload.probe is not None:
+        trace["probe"] = probes
+    return final, trace
 
 
 def run_traced(workload: Workload, cfg: EngineConfig, seed: int):

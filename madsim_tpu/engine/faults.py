@@ -74,6 +74,9 @@ ACTION_NAMES = (
     "resume",
 )
 
+#: stable wire name -> action code (the inverse, for literal schedules)
+ACTION_CODES = {name: i for i, name in enumerate(ACTION_NAMES)}
+
 # dedicated fold_in namespace for fault-schedule draws: disjoint from every
 # model's init namespace (0x7FFF_FFFF) and from per-event counters (< 2**31
 # in practice, but this constant is distinct regardless)
@@ -126,9 +129,33 @@ class FaultSpec(NamedTuple):
     pause_group: Group = (0, -1)
 
 
-def num_events(spec: FaultSpec) -> int:
-    """Static event count of the compiled campaign (every category
-    contributes an on/off pair per window)."""
+class FixedFaults(NamedTuple):
+    """A LITERAL fault schedule — the seedless counterpart of ``FaultSpec``.
+
+    ``events`` is a tuple of ``(time_ns, action_name, victim)`` triples —
+    the exact wire format ``replay.extract_fault_schedule`` and
+    ``madsim_tpu.faults.compile_host`` emit, so a recorded or shrunk
+    schedule (explore/shrink.py) drops straight back into any model's
+    ``faults=`` config slot and replays with NO randomness: the schedule
+    derivation returns the literal events for every seed. Still a pure
+    NamedTuple of python values (hashable, jit-key-safe). The three
+    override fields carry what burst "on" transitions need — the same
+    values ``FaultSpec`` carries — since a literal schedule has no spec
+    to read them from.
+    """
+
+    events: Tuple[Tuple[int, str, int], ...] = ()
+    spike_lat_lo_ns: int = 1_000_000_000
+    spike_lat_hi_ns: int = 5_000_000_000
+    burst_loss_q32: int = prob_to_q32(0.5)
+
+
+def num_events(spec) -> int:
+    """Static event count of the compiled campaign (every ``FaultSpec``
+    category contributes an on/off pair per window; a ``FixedFaults``
+    schedule is its literal length)."""
+    if isinstance(spec, FixedFaults):
+        return len(spec.events)
     return 2 * (
         spec.crashes + spec.partitions + spec.spikes + spec.losses + spec.pauses
     )
@@ -176,7 +203,7 @@ def _categories(spec: FaultSpec, num_nodes: int):
     )
 
 
-def schedule_events(spec: FaultSpec, num_nodes: int, key: jax.Array):
+def schedule_events(spec, num_nodes: int, key: jax.Array):
     """The shared schedule derivation: ``(times int64[E], actions int32[E],
     victims int32[E])`` in pair order (NOT time-sorted — the device queue
     orders by time at dispatch; the host supervisor sorts).
@@ -184,7 +211,28 @@ def schedule_events(spec: FaultSpec, num_nodes: int, key: jax.Array):
     Draw layout: per window pair i (in category order) the draws are
     ``rand[3i] = start``, ``rand[3i+1] = duration``, ``rand[3i+2] =
     victim`` — a fixed layout so adding windows to one category never
-    shifts another category's draws within the pair sequence."""
+    shifts another category's draws within the pair sequence.
+
+    A ``FixedFaults`` spec bypasses the draws entirely: the literal
+    events come back seed-independently (``key`` is unused), which is
+    what lets a shrunk schedule replay identically under any seed."""
+    if isinstance(spec, FixedFaults):
+        for t, action, vic in spec.events:
+            if action not in ACTION_CODES:
+                raise ValueError(f"unknown fault action {action!r}")
+            if not 0 <= vic < num_nodes:
+                raise ValueError(
+                    f"victim {vic} outside [0, {num_nodes}) in fixed "
+                    f"schedule event {(t, action, vic)!r}"
+                )
+        e = len(spec.events)
+        return (
+            jnp.asarray([t for t, _, _ in spec.events], jnp.int64).reshape(e),
+            jnp.asarray(
+                [ACTION_CODES[a] for _, a, _ in spec.events], jnp.int32
+            ).reshape(e),
+            jnp.asarray([v for _, _, v in spec.events], jnp.int32).reshape(e),
+        )
     e = num_events(spec)
     if e == 0:
         return (
@@ -212,7 +260,7 @@ def schedule_events(spec: FaultSpec, num_nodes: int, key: jax.Array):
 
 
 def compile_device(
-    spec: FaultSpec,
+    spec,  # FaultSpec | FixedFaults
     num_nodes: int,
     key: jax.Array,
     fault_kind: int,
@@ -299,7 +347,7 @@ def up(f: FaultState) -> jnp.ndarray:
 
 
 def on_event(
-    spec: FaultSpec,
+    spec,  # FaultSpec | FixedFaults (both carry the burst override fields)
     base: NetBase,
     links: enet.LinkState,
     f: FaultState,

@@ -407,6 +407,12 @@ def run_megasweep(state: EngineState, steps: int,
     S = state.seed.shape[0]
     if S % tile:
         raise ValueError(f"batch {S} must be a multiple of tile {tile}")
+    if state.cover.shape[1]:
+        raise ValueError(
+            "run_megasweep does not fold coverage bits (the probe "
+            "workload defines none); a cover-enabled workload would "
+            "silently report all-zero coverage"
+        )
     qn = state.queue.time.shape[1]
     qp = qn  # Mosaic pads lanes internally; keep logical width
 
@@ -475,6 +481,9 @@ def run_megasweep(state: EngineState, steps: int,
         done=done[:, 0].astype(bool),
         overflow=ov[:, 0].astype(bool),
         qmax=qmax[:, 0].astype(state.qmax.dtype),
+        # the probe workload defines no coverage signal (cover_bits=0), so
+        # the width-0 bitmap passes through untouched on both paths
+        cover=state.cover,
         queue=equeue.EventQueue(
             time=_join64(qthi, qtlo),
             kind=qkind,

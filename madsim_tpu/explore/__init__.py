@@ -1,0 +1,37 @@
+"""Coverage-guided fault-campaign exploration: find -> triage -> shrink.
+
+The sweep engine can run a million seeds under a declarative ``FaultSpec``
+and replay any one bit-exactly — this package *drives* that capacity
+toward bugs, the search loop FoundationDB-style simulation testing earns
+its keep through (AFL-style corpus guidance; Groce et al., *Swarm
+Testing*):
+
+- ``campaign`` — the corpus loop: mutate ``FaultSpec``s via seeded draws,
+  sweep each candidate, retain specs that light new coverage bits (the
+  engine's per-seed (kind x node x transition) bitmap, folded into the
+  chunk summary as ``coverage_map``), and report every violating seed.
+- ``triage`` — bucket violating seeds by failure fingerprint (violation
+  flavor + first-violation event signature from ``run_traced``), so
+  thousands of red seeds dedupe to a handful of distinct failures.
+- ``shrink`` — ddmin-reduce the extracted fault schedule to a minimal
+  ``FixedFaults`` literal that still reproduces the same fingerprint
+  under bit-exact CPU replay, plus campaign-window narrowing for the
+  next exploration round.
+- ``targets`` — the model adapters a campaign explores (the canonical
+  one: the amnesia Raft config, ``replay.amnesia_raft_config``).
+
+See ``docs/explore.md`` for the full pipeline and guarantees;
+``scripts/explore_demo.py`` runs it end to end on the CPU backend.
+"""
+
+from .campaign import (  # noqa: F401
+    CampaignConfig,
+    CampaignResult,
+    mutate_spec,
+    run_campaign,
+    spec_from_dict,
+    spec_to_dict,
+)
+from .shrink import ShrinkResult, narrow_windows, shrink  # noqa: F401
+from .targets import Target, amnesia_raft_target  # noqa: F401
+from .triage import Failure, fingerprint_counts, triage, triage_seed  # noqa: F401

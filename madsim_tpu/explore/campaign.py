@@ -1,0 +1,329 @@
+"""The corpus-driven campaign loop: coverage-guided FaultSpec search.
+
+AFL's loop lifted onto the sweep engine: keep a corpus of fault specs,
+mutate one (seeded draws — the whole campaign is a pure function of
+``campaign_seed``), sweep the candidate over a pinned seed range, and
+retain it iff the sweep lights coverage bits no earlier candidate
+reached. The coverage signal is the engine's per-seed
+(kind x node x transition) bitmap, OR-reduced into each chunk summary
+(``coverage_map``) — so guidance costs one extra reduction per chunk,
+never a second pass over the sweep.
+
+The seed range is the SAME for every candidate on purpose: coverage and
+violation differences between rounds are then attributable to the spec
+alone (the swarm-testing idiom — vary the fault mix, not the luck).
+
+Violating seeds surface in each round's record; chain them into
+``triage`` (dedupe by fingerprint) and ``shrink`` (minimal reproducing
+schedule). Long campaigns resume through the existing
+``engine/checkpoint.py`` machinery: with ``ckpt_dir`` set, every round's
+sweep checkpoints per-chunk summaries, and a restarted campaign (same
+config — candidates regenerate identically from the campaign seed) skips
+every chunk already on disk.
+
+The JSONL report is deterministic BY CONTRACT: records carry no wall
+times or absolute paths, and keys are sorted — two runs of one campaign
+seed produce byte-identical reports (``scripts/check_determinism.sh``
+gates this).
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from typing import List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+from ..engine import core as ecore
+from ..engine.faults import FaultSpec, FixedFaults, prob_to_q32
+from ..models._common import coverage_bit_count
+from .targets import Target
+
+# mutation clamps: windows/durations stay inside a sane explore envelope
+_MIN_NS = 10_000_000  # 10 ms
+_MAX_NS = 8_000_000_000  # 8 s
+_MAX_PHASES = 6  # per category
+_COUNT_FIELDS = ("crashes", "partitions", "spikes", "losses", "pauses")
+_WINDOW_FIELDS = (
+    "crash_window_ns",
+    "part_window_ns",
+    "spike_window_ns",
+    "loss_window_ns",
+    "pause_window_ns",
+)
+_DUR_FIELDS = (
+    ("restart_lo_ns", "restart_hi_ns"),
+    ("part_lo_ns", "part_hi_ns"),
+    ("spike_dur_lo_ns", "spike_dur_hi_ns"),
+    ("loss_dur_lo_ns", "loss_dur_hi_ns"),
+    ("pause_lo_ns", "pause_hi_ns"),
+)
+# scale factors as exact integer ratios (float scaling would make the
+# mutated spec depend on platform rounding)
+_SCALES = ((1, 2), (2, 3), (3, 2), (2, 1))
+
+
+class CampaignConfig(NamedTuple):
+    """Static campaign parameters (hashable, reprs stably)."""
+
+    rounds: int = 12
+    seeds_per_round: int = 256
+    seed0: int = 0  # the pinned sweep seed range is [seed0, seed0 + n)
+    campaign_seed: int = 0  # drives parent choice + mutations
+    chunk_size: int = 16384
+    mutations_hi: int = 2  # 1..hi mutations per candidate
+    stop_after_failures: int = 0  # stop once this many seeds violate (0 = never)
+    max_recorded_seeds: int = 8  # violating seeds listed per round record
+
+
+class CampaignResult(NamedTuple):
+    corpus: List[object]  # retained specs, oldest first (corpus[0] = base)
+    records: List[dict]  # one per executed round (the JSONL lines)
+    failures: List[Tuple[object, int]]  # (spec, violating seed), dedup order
+    coverage_map: List[int]  # global union bitmap words
+
+
+def _clamp_ns(v: int) -> int:
+    return max(_MIN_NS, min(_MAX_NS, int(v)))
+
+
+def _scale(rng: random.Random, v: int) -> int:
+    num, den = rng.choice(_SCALES)
+    return v * num // den
+
+
+def mutate_spec(
+    spec: FaultSpec, rng: random.Random, mutations_hi: int = 2
+) -> FaultSpec:
+    """One candidate: 1..``mutations_hi`` seeded mutations of ``spec``.
+
+    Mutations are the swarm-testing moves the issue names — add/drop a
+    storm or partition phase, widen/narrow a campaign window, scale
+    restart/burst durations and rates — all integer arithmetic, so a
+    mutated spec is identical across platforms for one rng state."""
+    for _ in range(rng.randint(1, max(1, mutations_hi))):
+        # weighted op choice: phase-count changes are the coarse knob
+        # that opens whole fault categories, so they get extra weight
+        op = rng.choice(
+            ("add", "add", "add", "drop", "window", "window", "dur", "rate")
+        )
+        if op == "add":
+            f = rng.choice(_COUNT_FIELDS)
+            spec = spec._replace(**{f: min(getattr(spec, f) + 1, _MAX_PHASES)})
+        elif op == "drop":
+            live = [f for f in _COUNT_FIELDS if getattr(spec, f) > 0]
+            if live:
+                f = rng.choice(live)
+                spec = spec._replace(**{f: getattr(spec, f) - 1})
+        elif op == "window":
+            f = rng.choice(_WINDOW_FIELDS)
+            spec = spec._replace(**{f: _clamp_ns(_scale(rng, getattr(spec, f)))})
+        elif op == "dur":
+            lo_f, hi_f = rng.choice(_DUR_FIELDS)
+            num, den = rng.choice(_SCALES)
+            lo = _clamp_ns(getattr(spec, lo_f) * num // den)
+            hi = _clamp_ns(getattr(spec, hi_f) * num // den)
+            spec = spec._replace(**{lo_f: lo, hi_f: max(hi, lo + 1)})
+        else:  # rate: burst loss probability / spike latency range
+            if rng.random() < 0.5:
+                q = _scale(rng, spec.burst_loss_q32)
+                spec = spec._replace(
+                    burst_loss_q32=max(
+                        prob_to_q32(0.05), min(prob_to_q32(0.95), q)
+                    )
+                )
+            else:
+                num, den = rng.choice(_SCALES)
+                lo = _clamp_ns(spec.spike_lat_lo_ns * num // den)
+                hi = _clamp_ns(spec.spike_lat_hi_ns * num // den)
+                spec = spec._replace(
+                    spike_lat_lo_ns=lo, spike_lat_hi_ns=max(hi, lo + 1)
+                )
+    return spec
+
+
+def spec_to_dict(spec) -> dict:
+    """JSON-stable encoding of a ``FaultSpec`` or ``FixedFaults``."""
+    if isinstance(spec, FixedFaults):
+        return {
+            "type": "FixedFaults",
+            "events": [[t, a, v] for t, a, v in spec.events],
+            "spike_lat_lo_ns": spec.spike_lat_lo_ns,
+            "spike_lat_hi_ns": spec.spike_lat_hi_ns,
+            "burst_loss_q32": spec.burst_loss_q32,
+        }
+    d = {"type": "FaultSpec"}
+    for f, v in zip(spec._fields, spec):
+        d[f] = list(v) if isinstance(v, tuple) else v
+    return d
+
+
+def spec_from_dict(d: dict):
+    """Inverse of ``spec_to_dict`` (report lines back to runnable specs)."""
+    d = dict(d)
+    kind = d.pop("type")
+    if kind == "FixedFaults":
+        return FixedFaults(
+            events=tuple((int(t), str(a), int(v)) for t, a, v in d["events"]),
+            spike_lat_lo_ns=int(d["spike_lat_lo_ns"]),
+            spike_lat_hi_ns=int(d["spike_lat_hi_ns"]),
+            burst_loss_q32=int(d["burst_loss_q32"]),
+        )
+    if kind != "FaultSpec":
+        raise ValueError(f"unknown spec encoding {kind!r}")
+    return FaultSpec(
+        **{f: tuple(v) if isinstance(v, list) else v for f, v in d.items()}
+    )
+
+
+def _sweep_candidate(
+    target: Target,
+    spec,
+    ccfg: CampaignConfig,
+    round_dir: Optional[str],
+) -> dict:
+    """Run one candidate's sweep over the pinned seed range; returns the
+    merged summary dict (coverage_map + violating_seeds included)."""
+    workload, ecfg = target.build(spec)
+    if workload.cover is None or workload.cover_bits == 0:
+        raise ValueError(
+            f"target {target.name!r} workload defines no coverage signal "
+            "(Workload.cover/cover_bits); without it the campaign loop "
+            "degenerates to unguided mutation of the base spec"
+        )
+    seeds = np.arange(
+        ccfg.seed0, ccfg.seed0 + ccfg.seeds_per_round, dtype=np.int64
+    )
+    # never let the chunk granule exceed the round budget: the resumable
+    # driver pads a ragged chunk to the full chunk_size for program
+    # reuse, which would blow a 128-seed explore round up to a
+    # 16k-lane sweep
+    chunk_size = min(ccfg.chunk_size, ccfg.seeds_per_round)
+
+    def summarize(final) -> dict:
+        s = dict(target.summarize(final))
+        vio = np.asarray(target.violating(final))
+        s["violating_seeds"] = [int(x) for x in vio[: ccfg.max_recorded_seeds]]
+        if "violations" not in s:
+            # the uncapped truth, so the round record never under-reports
+            # for a target whose summary lacks the key (sums per chunk)
+            s["violations"] = int(vio.size)
+        return s
+
+    if round_dir is not None:
+        # resumable leg: per-chunk summaries checkpoint through the
+        # existing machinery; a restarted campaign regenerates the same
+        # candidate (pure function of campaign_seed) and skips chunks
+        from ..engine.checkpoint import run_sweep_chunked_resumable
+
+        return run_sweep_chunked_resumable(
+            workload, ecfg, seeds, summarize, round_dir,
+            chunk_size=chunk_size,
+        )
+    final = ecore.run_sweep_chunked(
+        workload, ecfg, seeds, chunk_size=chunk_size
+    )
+    return summarize(final)
+
+
+def run_campaign(
+    target: Target,
+    base_spec: FaultSpec,
+    ccfg: CampaignConfig = CampaignConfig(),
+    report_path: Optional[str] = None,
+    ckpt_dir: Optional[str] = None,
+) -> CampaignResult:
+    """Drive the find loop: ``rounds`` candidates from ``base_spec``.
+
+    Round 0 sweeps the base spec itself (the bland starting point);
+    every later round mutates a uniformly drawn corpus parent. A
+    candidate joins the corpus iff its sweep lit coverage bits the
+    global union lacked. Stops early once ``stop_after_failures``
+    violating seeds have surfaced (0 = run every round).
+
+    ``report_path`` writes one JSONL record per executed round (plus a
+    header) — deterministic bytes per campaign seed. ``ckpt_dir`` makes
+    each round's sweep preemption-safe via per-chunk summary checkpoints
+    (``engine/checkpoint.py``)."""
+    import os
+
+    rng = random.Random(ccfg.campaign_seed)
+    corpus: List[object] = []
+    records: List[dict] = []
+    failures: List[Tuple[object, int]] = []
+    seen_failures = set()
+    global_map: List[int] = []
+
+    header = {
+        "campaign": ccfg._asdict(),
+        "target": target.name,
+        "base_spec": spec_to_dict(base_spec),
+    }
+
+    for r in range(ccfg.rounds):
+        if r == 0:
+            parent, spec = None, base_spec
+        else:
+            parent = rng.randrange(len(corpus)) if corpus else None
+            spec = mutate_spec(
+                corpus[parent] if parent is not None else base_spec,
+                rng,
+                ccfg.mutations_hi,
+            )
+        round_dir = (
+            os.path.join(ckpt_dir, f"round_{r:04d}") if ckpt_dir else None
+        )
+        summary = _sweep_candidate(target, spec, ccfg, round_dir)
+
+        cand_map = [int(w) for w in summary.get("coverage_map", [])]
+        if len(global_map) < len(cand_map):
+            global_map = global_map + [0] * (len(cand_map) - len(global_map))
+        new_bits = sum(
+            (c & ~g).bit_count() for c, g in zip(cand_map, global_map)
+        )
+        retained = r == 0 or new_bits > 0
+        if retained:
+            corpus.append(spec)
+            global_map = [g | c for g, c in zip(global_map, cand_map)]
+
+        vio = summary.get("violating_seeds", [])[: ccfg.max_recorded_seeds]
+        for seed in vio:
+            key = (spec, seed)
+            if key not in seen_failures:
+                seen_failures.add(key)
+                failures.append((spec, seed))
+
+        records.append(
+            {
+                "round": r,
+                "parent": parent,
+                "spec": spec_to_dict(spec),
+                "seeds": [ccfg.seed0, ccfg.seed0 + ccfg.seeds_per_round],
+                "violations": int(summary["violations"]),
+                "violating_seeds": vio,
+                "coverage_bits": coverage_bit_count(cand_map),
+                "new_bits": new_bits,
+                "coverage_total_bits": coverage_bit_count(global_map),
+                "retained": retained,
+                "events_total": int(summary.get("events_total", 0)),
+            }
+        )
+        if (
+            ccfg.stop_after_failures
+            and len(failures) >= ccfg.stop_after_failures
+        ):
+            break
+
+    if report_path is not None:
+        with open(report_path, "w") as f:
+            f.write(json.dumps(header, sort_keys=True) + "\n")
+            for rec in records:
+                f.write(json.dumps(rec, sort_keys=True) + "\n")
+
+    return CampaignResult(
+        corpus=corpus,
+        records=records,
+        failures=failures,
+        coverage_map=global_map,
+    )

@@ -1,0 +1,70 @@
+"""Explore targets: the model adapter a campaign/triage/shrink run drives.
+
+A ``Target`` is everything the explore loop needs to know about a model:
+how to build a (workload, engine config) pair for a candidate fault spec,
+how to summarize a finished sweep (the summary must carry
+``coverage_map`` — any ``models/_common.make_sweep_summary`` product
+does), and how to read an event's victim node out of a trace row for
+fingerprinting. Keeping this a 5-field adapter means a new model joins
+the explore pipeline with ~10 lines, no changes to the loop.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, NamedTuple, Tuple
+
+from ..engine.core import EngineConfig, Workload
+
+
+class Target(NamedTuple):
+    """One explorable model configuration family.
+
+    ``build(faults)`` maps a fault spec (``FaultSpec`` or ``FixedFaults``)
+    to a ready ``(Workload, EngineConfig)`` pair — everything else about
+    the model (nodes, workload plan, time limit) stays pinned, so
+    coverage/violation differences between candidates are attributable
+    to the fault environment alone."""
+
+    name: str
+    build: Callable[[object], Tuple[Workload, EngineConfig]]
+    summarize: Callable[[object], dict]
+    num_nodes: int
+    fault_kind: int
+    #: (kind, pay_row) -> victim node of the event, for fingerprints
+    node_of: Callable[[int, object], int]
+    #: finished batched EngineState -> violating seed array (the model
+    #: decides what "violating" means; raft latches wstate.violation)
+    violating: Callable[[object], object]
+
+
+def amnesia_raft_target(
+    time_limit_ns: int = 3_000_000_000, max_steps: int = 30_000
+) -> Target:
+    """The canonical explore target: the 3-node amnesia Raft cluster of
+    ``replay.amnesia_raft_config()`` — crash wipes durable state, so the
+    election-safety detector (``V_ELECTION``) can actually fire — with
+    the fault campaign left OPEN for the explore loop to choose."""
+    from ..models import raft
+    from ..replay import amnesia_raft_config, violation_seeds
+
+    base_cfg, _ = amnesia_raft_config()
+
+    def build(faults) -> Tuple[Workload, EngineConfig]:
+        cfg = base_cfg._replace(faults=faults)
+        ecfg = raft.engine_config(
+            cfg, time_limit_ns=time_limit_ns, max_steps=max_steps
+        )
+        return raft.workload(cfg), ecfg
+
+    def node_of(kind: int, pay) -> int:
+        return int(pay[1]) if kind == raft.K_FAULT else int(pay[0])
+
+    return Target(
+        name="raft-amnesia",
+        build=build,
+        summarize=raft.sweep_summary,
+        num_nodes=base_cfg.num_nodes,
+        fault_kind=raft.K_FAULT,
+        node_of=node_of,
+        violating=violation_seeds,
+    )

@@ -1,0 +1,92 @@
+"""Failure triage: bucket violating seeds by failure fingerprint.
+
+A campaign can flag thousands of red seeds; most are the same bug hit
+through different schedules. Triage re-runs each seed through
+``engine.run_traced`` (bit-exact on CPU) and reduces it to a
+**fingerprint** — the violation flavor bitmask the workload's ``probe``
+latched, plus the signature of the FIRST event that latched it (event
+kind + victim node). Seeds sharing a fingerprint are one failure class;
+the explore report and the shrinker work per class, not per seed.
+
+The fingerprint deliberately excludes times, steps and seeds: those vary
+per schedule even when the failure mechanism is identical. What it keeps
+is where the detector tripped (flavor) and what the tripping event was
+(kind, node) — stable across reruns by determinism, and stable across
+seeds of the same bug in practice.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..engine import core as ecore
+from .targets import Target
+
+
+class Failure(NamedTuple):
+    """One triaged violating seed."""
+
+    seed: int
+    flavor: int  # probe bitmask at the first violating event
+    step: int  # index of that event in the dispatch order
+    time_ns: int  # virtual time of that event
+    kind: int  # event kind dispatched at that step
+    node: int  # victim node of that event (target.node_of)
+    fingerprint: str  # the dedupe key: name:flavor:kind:node
+
+
+def triage_seed(target: Target, faults, seed: int) -> Optional[Failure]:
+    """Re-run one seed traced and locate its first violating event.
+
+    Returns None when the seed does not violate under ``faults`` (the
+    workload's probe never leaves zero) — the caller's signal that a
+    candidate schedule no longer reproduces."""
+    workload, ecfg = target.build(faults)
+    if workload.probe is None:
+        raise ValueError(
+            f"target {target.name!r} workload defines no probe; triage "
+            "needs the per-step violation flavor run_traced records"
+        )
+    _, trace = ecore.run_traced(workload, ecfg, seed)
+    fired = np.asarray(trace["fired"])
+    probe = np.asarray(trace["probe"])
+    hits = np.nonzero(fired & (probe != 0))[0]
+    if hits.size == 0:
+        return None
+    i = int(hits[0])
+    flavor = int(probe[i])
+    kind = int(np.asarray(trace["kind"])[i])
+    node = target.node_of(kind, np.asarray(trace["pay"])[i])
+    return Failure(
+        seed=int(seed),
+        flavor=flavor,
+        step=i,
+        time_ns=int(np.asarray(trace["time_ns"])[i]),
+        kind=kind,
+        node=node,
+        fingerprint=f"{target.name}:f{flavor}:k{kind}:n{node}",
+    )
+
+
+def triage(
+    target: Target, faults, seeds: Sequence[int]
+) -> Dict[str, List[Failure]]:
+    """Triage a batch of violating seeds into fingerprint buckets.
+
+    Returns ``{fingerprint: [Failure, ...]}`` with each bucket's seeds in
+    input order; seeds that do not violate are dropped (a campaign's
+    violating-seed list can only shrink under re-verification, never
+    grow)."""
+    buckets: Dict[str, List[Failure]] = {}
+    for seed in seeds:
+        f = triage_seed(target, faults, seed)
+        if f is not None:
+            buckets.setdefault(f.fingerprint, []).append(f)
+    return buckets
+
+
+def fingerprint_counts(buckets: Dict[str, List[Failure]]) -> Dict[str, int]:
+    """``{fingerprint: seed count}`` — the triage headline."""
+    return {fp: len(fails) for fp, fails in sorted(buckets.items())}

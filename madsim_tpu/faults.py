@@ -48,7 +48,9 @@ def compile_host(spec: FaultSpec, num_nodes: int, seed: int) -> List[FaultEvent]
     Runs the shared derivation (tiny — a few dozen integer draws) on the
     current JAX backend; the result is integer-only and therefore
     identical to what the device tier injects for the same ``(spec,
-    seed)``."""
+    seed)``. A literal ``engine.faults.FixedFaults`` schedule (e.g. a
+    shrunk one from ``explore/shrink.py``) compiles seed-independently —
+    its events come back verbatim, time-sorted."""
     import jax.numpy as jnp
     import numpy as np
 

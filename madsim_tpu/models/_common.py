@@ -24,18 +24,36 @@ DISABLED = None
 # here so every model's summary and every cross-chunk reducer agree
 MAX_KEYS = frozenset({"queue_high_water"})
 
+# keys that merge by elementwise bitwise-OR (coverage bitmaps: a bit is
+# covered by the sweep iff any chunk covered it)
+OR_KEYS = frozenset({"coverage_map"})
+
 
 def merge_summaries(totals: dict, summary: dict) -> dict:
     """Fold one chunk's ``sweep_summary`` dict into a running total.
 
-    All keys are additive counts except ``MAX_KEYS`` (high-water marks).
-    Mutates and returns ``totals`` (start with ``{}``)."""
+    Keys are additive counts except ``MAX_KEYS`` (high-water marks),
+    ``OR_KEYS`` (bitmap words, elementwise OR), and list values
+    (concatenated — e.g. per-chunk violating-seed samples). Mutates and
+    returns ``totals`` (start with ``{}``)."""
     for k, v in summary.items():
         if k in MAX_KEYS:
             totals[k] = max(totals.get(k, 0), v)
+        elif k in OR_KEYS:
+            old = totals.get(k, [])
+            if len(old) < len(v):
+                old = old + [0] * (len(v) - len(old))
+            totals[k] = [a | b for a, b in zip(old, list(v) + [0] * (len(old) - len(v)))]
+        elif isinstance(v, list):
+            totals[k] = totals.get(k, []) + v
         else:
             totals[k] = totals.get(k, 0) + v
     return totals
+
+
+def coverage_bit_count(coverage_map) -> int:
+    """Population count of a ``coverage_map`` word list (covered bits)."""
+    return sum(int(w).bit_count() for w in coverage_map)
 
 
 def memoized_workload(cfg_cls):
@@ -89,14 +107,25 @@ def make_sweep_summary(
 
     @jax.jit
     def _summarize(final):
-        return jnp.stack([jnp.asarray(f(final), jnp.int64) for f in fns])
+        scalars = jnp.stack([jnp.asarray(f(final), jnp.int64) for f in fns])
+        # coverage union rides in the same program/transfer: OR the
+        # per-seed bitmaps down the batch axis — the "one extra
+        # reduction" that turns the engine's in-loop signal into a
+        # chunk-level coverage map (explore/campaign.py feeds on it)
+        union = jax.lax.reduce(
+            final.cover, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+        )
+        return scalars, union
 
     def sweep_summary(final) -> dict:
         """Reduction of a finished sweep's batched EngineState (one
         device program, one transfer)."""
-        vec = np.asarray(_summarize(final))
+        vec, union = _summarize(final)
+        vec = np.asarray(vec)
         out = {"seeds": int(final.seed.shape[0])}
         out.update((n, int(v)) for n, v in zip(names, vec))
+        if union.shape[0]:
+            out["coverage_map"] = [int(w) for w in np.asarray(union)]
         return out
 
     return sweep_summary

@@ -49,7 +49,7 @@ Design notes:
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -112,7 +112,7 @@ class EtcdConfig(NamedTuple):
     bug_rev_regress: bool = False  # expiry decrements the revision
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a client-partition spec from the legacy fields above
-    faults: Optional[efaults.FaultSpec] = None
+    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
 
     @property
     def num_nodes(self) -> int:

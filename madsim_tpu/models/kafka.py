@@ -43,7 +43,7 @@ Design notes (shared with models/raft.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -105,7 +105,7 @@ class KafkaConfig(NamedTuple):
     bug_ack_on_append: bool = False
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a broker-crash spec from the legacy fields above
-    faults: Optional[efaults.FaultSpec] = None
+    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
 
     @property
     def num_nodes(self) -> int:

@@ -43,7 +43,7 @@ Design notes:
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -74,6 +74,14 @@ CANDIDATE = 1
 LEADER = 2
 
 PAYLOAD_SLOTS = 8
+
+# violation flavors (bitmask latched in ``viol_kind``; ``violation`` stays
+# the any-flavor bool). The explore subsystem's triage keys on these.
+V_ELECTION = 1  # two leaders elected in one term
+V_COMMIT = 2  # log-matching breach at commit
+
+N_KINDS = 5  # event kinds above
+N_ROLE_TRANS = 9  # role_before * 3 + role_after
 
 
 class RaftConfig(NamedTuple):
@@ -115,7 +123,7 @@ class RaftConfig(NamedTuple):
     volatile_state: bool = False
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a crash-storm spec from the legacy fields above
-    faults: Optional[efaults.FaultSpec] = None
+    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
 
 
 def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
@@ -158,7 +166,8 @@ class RaftState(NamedTuple):
     chist_term: jnp.ndarray  # int32
     chist_set: jnp.ndarray  # bool
     # sweep outputs
-    violation: jnp.ndarray  # bool
+    violation: jnp.ndarray  # bool (any flavor)
+    viol_kind: jnp.ndarray  # int32 flavor bitmask (V_ELECTION | V_COMMIT)
     log_overflow: jnp.ndarray  # bool
     elections: jnp.ndarray  # int32
     commits: jnp.ndarray  # int32 (total commit-index advancement)
@@ -215,6 +224,8 @@ def _record_election(cfg: RaftConfig, w: RaftState, term, node, won):
     slot = w.hist_pos % cfg.history
     return w._replace(
         violation=w.violation | (won & dup),
+        viol_kind=w.viol_kind
+        | jnp.where(won & dup, jnp.int32(V_ELECTION), jnp.int32(0)),
         hist_term=set1(w.hist_term, slot, term, won),
         hist_node=set1(w.hist_node, slot, node, won),
         hist_valid=set1(w.hist_valid, slot, True, won),
@@ -237,6 +248,8 @@ def _advance_commit(cfg: RaftConfig, w: RaftState, node, new_commit, enable):
         chist_term=jnp.where(fresh & ~w.chist_set, my_terms, w.chist_term),
         chist_set=w.chist_set | fresh,
         violation=w.violation | mismatch,
+        viol_kind=w.viol_kind
+        | jnp.where(mismatch, jnp.int32(V_COMMIT), jnp.int32(0)),
         commits=w.commits + (new - old).astype(jnp.int32),
     )
 
@@ -591,6 +604,39 @@ def _on_cmd(cfg: RaftConfig, w: RaftState, now, pay, rand):
     return w2, emits
 
 
+def cover_bits(cfg: RaftConfig) -> int:
+    """Size of the coverage bitmap: one bit per (event kind, node, role
+    transition) plus one bit per violation flavor."""
+    return N_KINDS * cfg.num_nodes * N_ROLE_TRANS + 2
+
+
+def _cover(cfg: RaftConfig, wb: RaftState, wa: RaftState, now, kind, pay):
+    """Map one dispatched event to its coverage bit (engine contract:
+    ``Workload.cover``). The bit is (kind x node x role-transition) — the
+    swarm-testing signal: a campaign that makes a node take a role
+    transition under an event kind no earlier spec reached lights a new
+    bit. A newly latched violation flavor claims the event's bit instead
+    (flavor bits are the rarest, most valuable coverage)."""
+    node = jnp.where(kind == K_FAULT, pay[1], pay[0])
+    node = jnp.clip(node, 0, cfg.num_nodes - 1)
+    trans = get1(wb.role, node) * 3 + get1(wa.role, node)
+    bit = (kind * cfg.num_nodes + node) * N_ROLE_TRANS + trans
+    base = N_KINDS * cfg.num_nodes * N_ROLE_TRANS
+    new_viol = wa.viol_kind & ~wb.viol_kind
+    return jnp.where(
+        new_viol != 0,
+        base + jnp.where((new_viol & V_ELECTION) != 0, 0, 1),
+        bit,
+    )
+
+
+def _probe(w: RaftState):
+    """Violation-flavor bitmask (engine contract: ``Workload.probe``) —
+    recorded per step by ``run_traced`` so triage can locate the first
+    violating event."""
+    return w.viol_kind
+
+
 def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
     branches = [
         partial(_on_election_timer, cfg),
@@ -637,6 +683,7 @@ def _init(cfg: RaftConfig, key):
         chist_term=jnp.zeros((cfg.log_cap,), jnp.int32),
         chist_set=jnp.zeros((cfg.log_cap,), bool),
         violation=jnp.zeros((), bool),
+        viol_kind=jnp.zeros((), jnp.int32),
         log_overflow=jnp.zeros((), bool),
         elections=jnp.zeros((), jnp.int32),
         commits=jnp.zeros((), jnp.int32),
@@ -681,6 +728,9 @@ def workload(cfg: RaftConfig = None) -> Workload:
         num_rand=2 * cfg.num_nodes + 3,
         payload_slots=PAYLOAD_SLOTS,
         max_emits=cfg.num_nodes + 2,
+        cover=partial(_cover, cfg),
+        cover_bits=cover_bits(cfg),
+        probe=_probe,
     )
 
 

@@ -50,7 +50,7 @@ Design notes (shared with models/kafka.py):
 from __future__ import annotations
 
 from functools import partial
-from typing import NamedTuple, Optional
+from typing import NamedTuple, Optional, Union
 
 import jax
 import jax.numpy as jnp
@@ -129,7 +129,7 @@ class S3Config(NamedTuple):
     bug_ack_before_durable: bool = False
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a server-crash spec from the legacy fields above
-    faults: Optional[efaults.FaultSpec] = None
+    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
 
     @property
     def num_nodes(self) -> int:

@@ -84,6 +84,7 @@ class GlobalRng:
         self._draw_count = 0
         # buggify (sim/buggify.rs; gate lives in rand.rs:113-134 in the ref)
         self.buggify_enabled = False
+        self.buggify_prob = 0.25  # default fire rate of bare buggify()
         # set by TimeHandle so log entries carry sim time
         self._now_ns = lambda: 0
 
@@ -180,7 +181,7 @@ class GlobalRng:
         return self.random() < prob
 
     def buggify(self) -> bool:
-        return self.buggify_with_prob(0.25)
+        return self.buggify_with_prob(self.buggify_prob)
 
 
 # -- ambient-context convenience API (rand.rs thread_rng/random) ----------

@@ -4,6 +4,8 @@
 # seeds, in two SEPARATE processes (fresh jit caches, fresh process
 # state), and byte-diff the dumped traces. Any drift in the schedule
 # derivation, the engine loop, or the fault interpreter fails the gate.
+# A second leg runs a tiny explore campaign twice the same way and
+# byte-diffs the JSONL reports (docs/explore.md determinism contract).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -55,6 +57,26 @@ dump "$out/b.npz"
 # must be byte-identical when every array is
 if cmp -s "$out/a.npz" "$out/b.npz"; then
   echo "determinism gate: OK (two processes, byte-identical traces)"
+
+  # explore leg: two campaign runs of one campaign seed must emit
+  # byte-identical JSONL reports (no shrink — this leg checks the
+  # campaign loop + coverage accounting, cheaply). The demo exits
+  # nonzero when its tiny budget finds no violation — expected here;
+  # only a MISSING report means the campaign itself crashed.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
+      --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
+      --report "$out/$r.jsonl" >"$out/$r.log" 2>&1 || true
+  done
+  if [ -s "$out/a.jsonl" ] && cmp -s "$out/a.jsonl" "$out/b.jsonl"; then
+    echo "determinism gate: OK (two campaign runs, byte-identical reports)"
+  else
+    echo "determinism gate: FAILED — campaign reports differ or are empty" >&2
+    diff "$out/a.jsonl" "$out/b.jsonl" >&2 || true
+    echo "--- explore_demo run logs ---" >&2
+    cat "$out/a.log" "$out/b.log" >&2 || true
+    exit 1
+  fi
 else
   echo "determinism gate: FAILED — traces differ between identical runs" >&2
   "${PY:-python}" - "$out/a.npz" "$out/b.npz" <<'EOF' >&2

@@ -1,0 +1,165 @@
+"""Coverage-guided exploration: find -> triage -> shrink (madsim_tpu/explore).
+
+Ground truth first: the flagship Raft safety detector must demonstrably
+FIRE (the round-5 VERDICT's named gap) — a pinned amnesia sweep yields
+violating seeds and a bit-exact CPU ``run_traced`` confirms each one.
+On top of that fixture, the explore acceptance: a campaign starting from
+a bland ``FaultSpec`` discovers a violating ``(spec, seed)``, triage
+assigns it a stable fingerprint, and the shrinker emits a minimal
+``FixedFaults`` schedule that still reproduces under bit-exact replay —
+all deterministic per campaign seed (byte-identical JSONL reports).
+"""
+
+import json
+import random
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu import explore, replay
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.faults import FaultSpec, FixedFaults
+from madsim_tpu.models import raft
+from madsim_tpu.models._common import coverage_bit_count, merge_summaries
+
+CFG, ECFG = replay.amnesia_raft_config()
+
+# the demo campaign: a bland one-crash spec the loop must escalate
+BLAND = FaultSpec(
+    crashes=1,
+    crash_window_ns=2_000_000_000,
+    restart_lo_ns=50_000_000,
+    restart_hi_ns=300_000_000,
+)
+CCFG = explore.CampaignConfig(
+    rounds=6, seeds_per_round=128, campaign_seed=1, stop_after_failures=1
+)
+
+
+# -- ground truth: the safety detector fires --------------------------------
+
+
+def test_amnesia_detector_demonstrably_fires():
+    """Tier-1 proof the flagship detector works: the pinned amnesia
+    config over a pinned seed range yields >= 1 violating seed, and a
+    bit-exact CPU trace confirms the violation with its flavor — the
+    explore subsystem's ground-truth fixture."""
+    final = ecore.run_sweep(
+        raft.workload(CFG), ECFG, jnp.arange(160, dtype=jnp.int64)
+    )
+    vio = replay.violation_seeds(final)
+    assert vio.size >= 1, "amnesia sweep found no violations"
+    seed = int(vio[0])
+    single, trace = ecore.run_traced(raft.workload(CFG), ECFG, seed)
+    assert bool(single.wstate.violation)
+    assert int(single.wstate.viol_kind) & raft.V_ELECTION
+    # the traced probe channel pinpoints the first violating event
+    probe = np.asarray(trace["probe"])
+    fired = np.asarray(trace["fired"])
+    hits = np.nonzero(fired & (probe != 0))[0]
+    assert hits.size > 0 and probe[hits[0]] & raft.V_ELECTION
+
+
+# -- the coverage signal -----------------------------------------------------
+
+
+def test_coverage_bitmap_chunking_invariant():
+    """The chunk summary's coverage union is the same whether a sweep
+    runs as one batch or as chunks merged through ``merge_summaries``
+    (seeds are independent; coverage is a per-seed OR)."""
+    seeds = jnp.arange(96, dtype=jnp.int64)
+    whole = raft.sweep_summary(ecore.run_sweep(raft.workload(CFG), ECFG, seeds))
+    totals = {}
+    for lo in (0, 32, 64):
+        final = ecore.run_sweep(raft.workload(CFG), ECFG, seeds[lo : lo + 32])
+        merge_summaries(totals, raft.sweep_summary(final))
+    assert totals["coverage_map"] == whole["coverage_map"]
+    assert coverage_bit_count(whole["coverage_map"]) > 0
+    assert len(whole["coverage_map"]) == (raft.cover_bits(CFG) + 31) // 32
+
+
+def test_mutations_are_deterministic_and_bounded():
+    a = explore.mutate_spec(BLAND, random.Random(42))
+    b = explore.mutate_spec(BLAND, random.Random(42))
+    assert a == b, "same rng state must yield the same candidate"
+    for _ in range(200):
+        s = explore.mutate_spec(BLAND, random.Random(_))
+        for f in ("crashes", "partitions", "spikes", "losses", "pauses"):
+            assert 0 <= getattr(s, f) <= 6
+        assert s.restart_lo_ns < s.restart_hi_ns
+        # every candidate round-trips through the JSONL encoding
+        assert explore.spec_from_dict(explore.spec_to_dict(s)) == s
+    fixed = FixedFaults(events=((5, "crash", 0), (9, "restart", 0)))
+    assert explore.spec_from_dict(explore.spec_to_dict(fixed)) == fixed
+
+
+# -- the acceptance loop: find -> triage -> shrink ---------------------------
+
+
+def test_campaign_finds_triages_and_shrinks_the_amnesia_bug(tmp_path):
+    """End-to-end on CPU: the coverage-guided campaign escalates a bland
+    spec into a violating (spec, seed); triage fingerprints it; the
+    shrinker's minimal FixedFaults schedule still reproduces the SAME
+    fingerprint under bit-exact run_traced replay."""
+    target = explore.amnesia_raft_target()
+    report = tmp_path / "campaign.jsonl"
+    result = explore.run_campaign(
+        target, BLAND, CCFG, report_path=str(report)
+    )
+    # 1. find: the loop discovered a violating (spec, seed) and stopped
+    assert result.failures, "campaign never found a violating seed"
+    spec, seed = result.failures[0]
+    assert spec != BLAND, "the bland base spec itself should stay quiet"
+    # coverage guidance did the driving: the corpus grew beyond the base
+    assert len(result.corpus) >= 2
+    assert coverage_bit_count(result.coverage_map) > 0
+
+    # 2. triage: every red seed lands in a bucket with a stable key
+    buckets = explore.triage(target, spec, [s for _, s in result.failures])
+    assert sum(len(v) for v in buckets.values()) == len(result.failures)
+    fp = explore.triage_seed(target, spec, seed).fingerprint
+    assert fp in buckets
+    assert explore.triage_seed(target, spec, seed).fingerprint == fp  # stable
+
+    # 3. shrink: minimal schedule, re-verified, still the same failure
+    sr = explore.shrink(target, spec, seed, max_tests=32)
+    assert sr is not None
+    assert sr.fingerprint == fp
+    assert len(sr.schedule) <= sr.original_len
+    assert sr.schedule == tuple(sorted(sr.schedule))
+    # the minimal triple reproduces standalone (fresh replay, literal
+    # schedule — no draws left anywhere in the fault path)
+    again = explore.triage_seed(target, sr.spec, sr.seed)
+    assert again is not None and again.fingerprint == fp
+
+    # the report is well-formed JSONL: header + one record per round
+    lines = [json.loads(l) for l in report.read_text().splitlines()]
+    assert lines[0]["target"] == target.name
+    assert len(lines) == 1 + len(result.records)
+    assert lines[-1]["violating_seeds"], "last round holds the discovery"
+
+
+def test_campaign_report_is_byte_deterministic(tmp_path):
+    """Two runs of one campaign seed produce byte-identical JSONL (the
+    in-process half of scripts/check_determinism.sh's two-process gate)."""
+    target = explore.amnesia_raft_target()
+    a, b = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    ccfg = CCFG._replace(rounds=3, stop_after_failures=0)
+    ra = explore.run_campaign(target, BLAND, ccfg, report_path=str(a))
+    rb = explore.run_campaign(target, BLAND, ccfg, report_path=str(b))
+    assert a.read_bytes() == b.read_bytes()
+    assert ra.records == rb.records
+
+
+def test_campaign_resumes_through_checkpoints(tmp_path):
+    """With ckpt_dir set, a rerun skips every completed chunk (the
+    engine/checkpoint.py machinery) and reproduces the identical result."""
+    target = explore.amnesia_raft_target()
+    ccfg = CCFG._replace(rounds=2, stop_after_failures=0, seeds_per_round=64)
+    ck = tmp_path / "ck"
+    r1 = explore.run_campaign(target, BLAND, ccfg, ckpt_dir=str(ck))
+    files = sorted(p.name for p in (ck / "round_0000").glob("chunk_*.json"))
+    assert files, "no per-chunk checkpoints written"
+    r2 = explore.run_campaign(target, BLAND, ccfg, ckpt_dir=str(ck))
+    assert r1.records == r2.records
+    assert r1.coverage_map == r2.coverage_map

@@ -238,6 +238,37 @@ def test_buggify_default_off_and_distribution():
     rt.block_on(main())
 
 
+def test_buggify_enabled_scope_restores_and_nests():
+    """The context-manager gate: scoped enable (with optional prob
+    override) restores the prior state on exit — including across
+    nesting and exceptions — so buggified sections never leak."""
+    rt = ms.Runtime(seed=9)
+
+    async def main():
+        assert not ms.buggify.is_enabled()
+        with ms.buggify.enabled():
+            assert ms.buggify.is_enabled()
+            # re-entrant: the inner scope's prob override unwinds to the
+            # outer scope's view, then fully off at the end
+            with ms.buggify.enabled(prob=1.0):
+                assert ms.buggify.buggify()  # fires always at prob=1
+            assert ms.buggify.is_enabled()
+            hits = sum(ms.buggify.buggify() for _ in range(2000))
+            assert 400 < hits < 600  # back on the 25% default
+        assert not ms.buggify.is_enabled()
+        assert not ms.buggify.buggify()
+        # exception-safe: the gate state survives a raising scope
+        try:
+            with ms.buggify.enabled(prob=1.0):
+                raise RuntimeError("boom")
+        except RuntimeError:
+            pass
+        assert not ms.buggify.is_enabled()
+        assert ms.current_handle().rng.buggify_prob == 0.25
+
+    rt.block_on(main())
+
+
 def test_seed_is_exposed():
     rt = ms.Runtime(seed=31337)
     assert rt.seed == 31337

@@ -29,7 +29,7 @@ def _sweep(n_seeds=160):
 
 
 def test_sweep_to_host_replay_end_to_end():
-    # 1. the sweep flags violation seeds (deterministic: 50, 93, 136, ...)
+    # 1. the sweep flags violation seeds (deterministic: 6, 16, 46, ...)
     final = _sweep()
     vio = replay.violation_seeds(final)
     assert vio.size > 0, "amnesia sweep found no violations"
@@ -73,6 +73,46 @@ def test_fault_plan_extraction_is_deterministic():
     p1 = replay.extract_fault_schedule(t1, raft.K_FAULT)
     p2 = replay.extract_fault_schedule(t2, raft.K_FAULT)
     assert p1 == p2 and len(p1) == 2 * CFG.crashes
+
+
+def test_fault_schedule_horizon_clipping_is_a_strict_prefix():
+    """The documented divergence, asserted: a spec whose windows reach
+    past ``time_limit_ns`` yields a traced device schedule that is a
+    STRICT time-prefix of ``compile_host``'s — an event drawn at or past
+    the horizon appears on the host list but never fires in the trace
+    (docs/faults.md "sizing caveat")."""
+    from madsim_tpu import faults as hfaults
+    from madsim_tpu.engine import faults as efaults
+    from madsim_tpu.models import raft as raft_mod
+
+    limit = int(ECFG.time_limit_ns)
+    spec = efaults.FaultSpec(
+        crashes=2,
+        crash_window_ns=2 * limit,  # draws straddle the horizon
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=300_000_000,
+    )
+    cfg = CFG._replace(faults=spec)
+    ecfg = raft_mod.engine_config(cfg, time_limit_ns=limit, max_steps=30_000)
+
+    # pinned deterministic scan: the first seed whose host schedule has
+    # events on both sides of the horizon, none inside a +-1 us guard
+    # band (the engine's accumulated 50-100 ns dispatch jitter decides
+    # borderline events; the band keeps the assertion jitter-proof)
+    for seed in range(32):
+        host = hfaults.compile_host(spec, cfg.num_nodes, seed)
+        before = [e for e in host if e[0] < limit - 1_000_000]
+        after = [e for e in host if e[0] > limit + 1_000_000]
+        if before and after and len(before) + len(after) == len(host):
+            break
+    else:
+        raise AssertionError("no straddling seed in the pinned range")
+
+    _, trace = ecore.run_traced(raft_mod.workload(cfg), ecfg, seed)
+    device = replay.extract_fault_schedule(trace, raft_mod.K_FAULT)
+    assert device == host[: len(device)], "not a prefix of the host schedule"
+    assert len(device) < len(host), "horizon clipping did not drop anything"
+    assert device == before, "device fired exactly the pre-horizon events"
 
 
 def test_durable_state_config_stays_quiet():
