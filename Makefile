@@ -10,10 +10,14 @@
 #   make explore-smoke the explore pipeline end to end on a tiny budget
 #                      (CPU backend, fixed campaign seed: find -> triage
 #                      -> shrink against the amnesia raft target)
+#   make oracle-smoke  the history-oracle pipeline end to end (seeded
+#                      etcd bug -> linearizability checker -> triage ->
+#                      shrink -> cross-path history byte identity)
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
-#                      plus two campaign runs, JSONL reports byte-diffed)
-#                      + explore-smoke
+#                      plus two campaign runs, JSONL reports byte-diffed;
+#                      plus two history decodes, bytes diffed)
+#                      + explore-smoke + oracle-smoke
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality
 #   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
@@ -27,7 +31,7 @@ PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
-	explore-smoke dryrun bench-smoke test-all
+	explore-smoke oracle-smoke dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -42,7 +46,13 @@ explore-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/explore_demo.py \
 	  --rounds 6 --seeds-per-round 128 --campaign-seed 5
 
-stest: test determinism explore-smoke
+# the history-oracle pipeline end to end (docs/oracle.md): seeded etcd
+# stale-read bug -> WGL checker rejects -> history-flavor triage ->
+# checker-verified shrink -> sweep/traced byte identity -> clean control
+oracle-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/oracle_demo.py
+
+stest: test determinism explore-smoke oracle-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

@@ -19,6 +19,7 @@ Layer map (mirrors reference /root/reference, see SURVEY.md §1):
   L7 codegen/macros     -> decorators (@sim_test, @service, @request)
   L8 test driver        -> madsim_tpu.builder
   TPU tier              -> madsim_tpu.{engine,models,parallel,ops}
+  correctness tooling   -> madsim_tpu.{explore,oracle,replay,faults}
 
 (The L6 ecosystem shims and the TPU tier are built progressively — check the
 package tree for what is present in this revision.)

@@ -22,7 +22,10 @@ from .core import EngineConfig, EngineState, Workload
 #     EventQueue, so v2 files would load positionally misaligned.
 # v4: EngineState gained the per-seed coverage bitmap (``cover``), so v3
 #     files would load positionally misaligned.
-_FORMAT_VERSION = 4
+# v5: EngineState gained the operation-history plane (``hist_rec``,
+#     ``hist_t``, ``hist_len``, ``hist_overflow`` — madsim_tpu/oracle),
+#     so v4 files would load positionally misaligned.
+_FORMAT_VERSION = 5
 
 
 def save_sweep(state: EngineState, path: str) -> None:
@@ -185,7 +188,12 @@ def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
     change a chunk's summary, only its wall-clock. ``cover_bits`` is
     INCLUDED: it changes the summary schema (``coverage_map`` appears),
     so chunk summaries written by a coverage-free workload must not
-    silently merge into a coverage-guided sweep as zero coverage."""
+    silently merge into a coverage-guided sweep as zero coverage.
+    ``hist_slots`` is included for the same reason in reverse: a resized
+    history buffer changes which seeds latch ``hist_overflow``, so their
+    chunk summaries are not interchangeable."""
+    from .core import hist_slots
+
     init = workload.init
     fn = getattr(init, "func", init)
     args = getattr(init, "args", ())
@@ -194,5 +202,5 @@ def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
     )
     return (
         f"{fn.__module__}.{fn.__qualname__}|{args!r}|{cfg_id!r}"
-        f"|cover{workload.cover_bits}"
+        f"|cover{workload.cover_bits}|hist{hist_slots(workload)}"
     )

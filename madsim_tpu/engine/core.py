@@ -40,6 +40,12 @@ from . import queue as equeue
 from .queue import EventQueue
 from .rng import bounded, event_bits, seed_key
 
+# Columns of one fixed-width operation-history record (madsim_tpu/oracle):
+# (client, code, key, val, opid) as int32; the engine stamps the record's
+# int64 virtual time itself. The oracle decoder owns the field semantics —
+# the engine only owns the width and the append discipline.
+HIST_COLS = 5
+
 
 class Emits(NamedTuple):
     """Fixed-size batch of events emitted by one handler invocation."""
@@ -85,11 +91,28 @@ class Workload(NamedTuple):
     # (0 = no violation). ``run_traced`` records it per step so triage
     # (explore/triage.py) can locate the FIRST violating event.
     probe: Optional[Callable[[Any], jnp.ndarray]] = None
+    # Optional operation-history recording (madsim_tpu/oracle):
+    # ``record(wstate_before, wstate_after, now_ns, kind, pay) ->
+    # (slot_op, enable)`` maps each dispatched event to at most one
+    # fixed-width op record — ``slot_op`` is int32[HIST_COLS]
+    # (client, code, key, val, opid); the engine stamps the event's
+    # virtual time and appends the row to the per-seed history buffer in
+    # the same step (one masked write, like the coverage plane). A full
+    # buffer latches the sticky ``hist_overflow`` flag and DROPS the row
+    # — it never wraps, so the recorded prefix stays a valid history.
+    # ``hist_slots == 0`` disables the plane entirely.
+    record: Optional[Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]] = None
+    hist_slots: int = 0
 
 
 def cover_words(workload: Workload) -> int:
     """uint32 words of the per-seed coverage bitmap (0 when disabled)."""
     return (workload.cover_bits + 31) // 32
+
+
+def hist_slots(workload: Workload) -> int:
+    """Rows of the per-seed history buffer (0 when recording is off)."""
+    return workload.hist_slots if workload.record is not None else 0
 
 
 class EngineConfig(NamedTuple):
@@ -128,6 +151,12 @@ class EngineState(NamedTuple):
     overflow: jnp.ndarray  # bool sticky queue-overflow flag
     qmax: jnp.ndarray  # int32 queue-occupancy high-water mark
     cover: jnp.ndarray  # uint32[cover_words] per-seed coverage bitmap
+    # operation-history plane (madsim_tpu/oracle); all empty-shaped when
+    # the workload records no history
+    hist_rec: jnp.ndarray  # int32[hist_slots, HIST_COLS] op records
+    hist_t: jnp.ndarray  # int64[hist_slots] record virtual times
+    hist_len: jnp.ndarray  # int32 rows appended so far
+    hist_overflow: jnp.ndarray  # bool sticky history-overflow flag
     queue: EventQueue
     wstate: Any  # workload pytree
 
@@ -162,6 +191,10 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
         overflow=overflow,
         qmax=equeue.size(q),
         cover=jnp.zeros((cover_words(workload),), jnp.uint32),
+        hist_rec=jnp.zeros((hist_slots(workload), HIST_COLS), jnp.int32),
+        hist_t=jnp.zeros((hist_slots(workload),), jnp.int64),
+        hist_len=jnp.zeros((), jnp.int32),
+        hist_overflow=jnp.zeros((), bool),
         queue=q,
         wstate=wstate,
     )
@@ -237,6 +270,25 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
             hit, jnp.uint32(1) << (bit & 31), jnp.uint32(0)
         )
 
+    # history: append this event's op record (if any) at the write head —
+    # one masked [H]-sized write in the same step, mirroring the coverage
+    # plane. A full buffer latches the sticky overflow flag and drops the
+    # row; the already-written prefix is never touched (no wrap).
+    hist_rec, hist_t = s.hist_rec, s.hist_t
+    hist_len, hist_ov = s.hist_len, s.hist_overflow
+    if workload.record is not None and workload.hist_slots > 0:
+        h = workload.hist_slots
+        rec, ren = workload.record(s.wstate, wstate, now, kind, pay)
+        want = take & jnp.asarray(ren, bool)
+        fits = hist_len < h
+        row = (jnp.arange(h, dtype=jnp.int32) == hist_len) & want & fits
+        hist_rec = jnp.where(
+            row[:, None], jnp.asarray(rec, jnp.int32)[None, :], hist_rec
+        )
+        hist_t = jnp.where(row, now, hist_t)
+        hist_len = hist_len + jnp.where(want & fits, 1, 0)
+        hist_ov = hist_ov | (want & ~fits)
+
     def sel(pred, new, old):
         return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
 
@@ -249,6 +301,10 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         overflow=s.overflow | (take & ov),
         qmax=jnp.maximum(s.qmax, equeue.size(q)),
         cover=cover,
+        hist_rec=hist_rec,
+        hist_t=hist_t,
+        hist_len=hist_len,
+        hist_overflow=hist_ov,
         queue=q,
         wstate=sel(take, wstate, s.wstate),
     )

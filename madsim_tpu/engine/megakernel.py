@@ -413,6 +413,12 @@ def run_megasweep(state: EngineState, steps: int,
             "workload defines none); a cover-enabled workload would "
             "silently report all-zero coverage"
         )
+    if state.hist_rec.shape[1]:
+        raise ValueError(
+            "run_megasweep does not append op-history records (the probe "
+            "workload records none); a record-enabled workload would "
+            "silently report an empty history"
+        )
     qn = state.queue.time.shape[1]
     qp = qn  # Mosaic pads lanes internally; keep logical width
 
@@ -481,9 +487,14 @@ def run_megasweep(state: EngineState, steps: int,
         done=done[:, 0].astype(bool),
         overflow=ov[:, 0].astype(bool),
         qmax=qmax[:, 0].astype(state.qmax.dtype),
-        # the probe workload defines no coverage signal (cover_bits=0), so
-        # the width-0 bitmap passes through untouched on both paths
+        # the probe workload defines no coverage signal (cover_bits=0) and
+        # no history recording (hist_slots=0), so the width-0 planes pass
+        # through untouched on both paths
         cover=state.cover,
+        hist_rec=state.hist_rec,
+        hist_t=state.hist_t,
+        hist_len=state.hist_len,
+        hist_overflow=state.hist_overflow,
         queue=equeue.EventQueue(
             time=_join64(qthi, qtlo),
             kind=qkind,

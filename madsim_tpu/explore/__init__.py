@@ -11,7 +11,9 @@ Testing*):
   engine's per-seed (kind x node x transition) bitmap, folded into the
   chunk summary as ``coverage_map``), and report every violating seed.
 - ``triage`` — bucket violating seeds by failure fingerprint (violation
-  flavor + first-violation event signature from ``run_traced``), so
+  flavor + first-violation event signature from ``run_traced``; or, with
+  ``history=True``, the op ending the first non-linearizable prefix of
+  the seed's recorded history — the madsim_tpu/oracle flavor), so
   thousands of red seeds dedupe to a handful of distinct failures.
 - ``shrink`` — ddmin-reduce the extracted fault schedule to a minimal
   ``FixedFaults`` literal that still reproduces the same fingerprint
@@ -33,5 +35,11 @@ from .campaign import (  # noqa: F401
     spec_to_dict,
 )
 from .shrink import ShrinkResult, narrow_windows, shrink  # noqa: F401
-from .targets import Target, amnesia_raft_target  # noqa: F401
-from .triage import Failure, fingerprint_counts, triage, triage_seed  # noqa: F401
+from .targets import Target, amnesia_raft_target, stale_etcd_target  # noqa: F401
+from .triage import (  # noqa: F401
+    HISTORY_FLAVOR,
+    Failure,
+    fingerprint_counts,
+    triage,
+    triage_seed,
+)

@@ -123,15 +123,23 @@ def ddmin(
 
 
 def shrink(
-    target: Target, spec, seed: int, max_tests: int = 64
+    target: Target, spec, seed: int, max_tests: int = 64,
+    history: bool = False,
 ) -> Optional[ShrinkResult]:
     """Shrink one ``(spec, seed)`` failure to a minimal verified triple.
 
     Returns None when the seed does not violate under ``spec``, or when
     the refit literal schedule fails to reproduce the fingerprint (a
     schedule event past the engine horizon would be the usual cause —
-    see ``replay.extract_fault_schedule``)."""
-    f0 = triage_seed(target, spec, seed)
+    see ``replay.extract_fault_schedule``).
+
+    ``history=True`` re-verifies every ddmin candidate through the
+    history oracle (``triage_seed(..., history=True)`` — decode the
+    candidate replay's op history, reject iff the linearizability
+    checker still rejects with the same fingerprint) instead of the
+    model probe; the resulting minimal triple is thus checker-verified,
+    not probe-verified."""
+    f0 = triage_seed(target, spec, seed, history=history)
     if f0 is None:
         return None
     workload, ecfg = target.build(spec)
@@ -147,7 +155,9 @@ def shrink(
     def run(events: List[FaultEvent]) -> Optional[Failure]:
         key = tuple(events)
         if key not in replayed:
-            replayed[key] = triage_seed(target, to_fixed(spec, events), seed)
+            replayed[key] = triage_seed(
+                target, to_fixed(spec, events), seed, history=history
+            )
         return replayed[key]
 
     def reproduces(events: List[FaultEvent]) -> bool:

@@ -11,7 +11,7 @@ the explore pipeline with ~10 lines, no changes to the loop.
 
 from __future__ import annotations
 
-from typing import Callable, NamedTuple, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 from ..engine.core import EngineConfig, Workload
 
@@ -33,8 +33,13 @@ class Target(NamedTuple):
     #: (kind, pay_row) -> victim node of the event, for fingerprints
     node_of: Callable[[int, object], int]
     #: finished batched EngineState -> violating seed array (the model
-    #: decides what "violating" means; raft latches wstate.violation)
+    #: decides what "violating" means; raft latches wstate.violation,
+    #: history targets run the linearizability checker per lane)
     violating: Callable[[object], object]
+    #: sequential spec (oracle/specs.py) for the workload's recorded op
+    #: histories; set iff the workload records one (enables the
+    #: ``history`` triage flavor and history-verified shrinking)
+    hist_spec: Optional[object] = None
 
 
 def amnesia_raft_target(
@@ -67,4 +72,61 @@ def amnesia_raft_target(
         fault_kind=raft.K_FAULT,
         node_of=node_of,
         violating=violation_seeds,
+    )
+
+
+# the fault environment the history-oracle pipeline runs under — ONE
+# definition shared by scripts/oracle_demo.py, scripts/replay_seed.py
+# (--model etcd) and the determinism gate's history leg, so a seed one
+# of them reports reproduces under the others (same (spec, seed) ->
+# same schedule -> same decoded history)
+def oracle_demo_faults():
+    from ..engine.faults import FaultSpec
+
+    return FaultSpec(
+        partitions=2, part_window_ns=1_500_000_000, part_group=(1, -1)
+    )
+
+
+def stale_etcd_target(
+    time_limit_ns: int = 2_000_000_000,
+    max_steps: int = 20_000,
+    hist_slots: int = 256,
+    bug_stale_read: bool = True,
+) -> Target:
+    """The history-oracle demo target: the etcd cluster with
+    ``bug_stale_read`` seeded — GETs serve the pre-mutation value, which
+    no online invariant latch can see (revision and lease bookkeeping
+    stay intact) — and history recording on, so "violating" means *the
+    WGL checker rejects the seed's decoded history* against the KV
+    register spec. Pass ``bug_stale_read=False`` for the matching clean
+    control (the checker must stay quiet over any pinned seed range)."""
+    from ..models import etcd
+    from ..oracle import KVSpec
+    from ..oracle.check import violating_seeds as history_violating
+
+    base_cfg = etcd.EtcdConfig(
+        bug_stale_read=bug_stale_read, hist_slots=hist_slots
+    )
+    spec = KVSpec()
+
+    def build(faults) -> Tuple[Workload, EngineConfig]:
+        cfg = base_cfg._replace(faults=faults)
+        ecfg = etcd.engine_config(
+            cfg, time_limit_ns=time_limit_ns, max_steps=max_steps
+        )
+        return etcd.workload(cfg), ecfg
+
+    def node_of(kind: int, pay) -> int:
+        return int(pay[1]) if kind == etcd.K_FAULT else int(pay[0])
+
+    return Target(
+        name="etcd-stale" if bug_stale_read else "etcd-clean",
+        build=build,
+        summarize=etcd.sweep_summary,
+        num_nodes=base_cfg.num_nodes,
+        fault_kind=etcd.K_FAULT,
+        node_of=node_of,
+        violating=lambda final: history_violating(final, spec),
+        hist_spec=spec,
     )

@@ -13,6 +13,13 @@ per schedule even when the failure mechanism is identical. What it keeps
 is where the detector tripped (flavor) and what the tripping event was
 (kind, node) — stable across reruns by determinism, and stable across
 seeds of the same bug in practice.
+
+A second fingerprint flavor rides on the history oracle
+(madsim_tpu/oracle): with ``history=True`` a seed is re-run traced, its
+recorded operation history decoded, and the failure keyed on the op
+ending the **first non-linearizable prefix** — no model-specific probe
+needed, just a ``Target.hist_spec``. History fingerprints keep the op
+kind and drop keys/clients (those vary per schedule like times do).
 """
 
 from __future__ import annotations
@@ -23,6 +30,10 @@ import numpy as np
 
 from ..engine import core as ecore
 from .targets import Target
+
+# Failure.flavor value marking a history-oracle failure (probe bitmask
+# flavors are non-negative)
+HISTORY_FLAVOR = -1
 
 
 class Failure(NamedTuple):
@@ -37,13 +48,61 @@ class Failure(NamedTuple):
     fingerprint: str  # the dedupe key: name:flavor:kind:node
 
 
-def triage_seed(target: Target, faults, seed: int) -> Optional[Failure]:
+def _triage_history(target: Target, workload, ecfg, seed: int) -> Optional[Failure]:
+    """History-oracle triage: decode the seed's recorded op history and
+    fingerprint the op that ends the first non-linearizable prefix.
+    ``step`` is that op's index in the decoded history (not a dispatch
+    step), ``kind`` its op code, ``node`` its client.
+
+    A one-lane ``run_sweep`` replaces ``run_traced`` here: history
+    triage reads only the final state's history buffer, which the two
+    paths fill bit-identically (the byte contract tests/test_oracle.py
+    pins), and the sweep neither materializes the max_steps-sized trace
+    arrays nor runs past the seed's completion — this is the inner loop
+    of ``shrink(history=True)``, one replay per ddmin candidate."""
+    import jax.numpy as jnp
+
+    from ..oracle import check_history, decode_seed
+    from ..oracle.history import OP_NAMES
+
+    if target.hist_spec is None:
+        raise ValueError(
+            f"target {target.name!r} declares no hist_spec; history "
+            "triage needs the sequential spec to check decoded ops against"
+        )
+    if workload.record is None or workload.hist_slots == 0:
+        raise ValueError(
+            f"target {target.name!r} workload records no op history "
+            "(Workload.record/hist_slots); there is nothing to check"
+        )
+    final = ecore.run_sweep(workload, ecfg, jnp.asarray([seed], jnp.int64))
+    result = check_history(decode_seed(final, 0), target.hist_spec)
+    if result.ok:
+        return None
+    op = result.bad_op
+    return Failure(
+        seed=int(seed),
+        flavor=HISTORY_FLAVOR,
+        step=result.bad_index,
+        time_ns=op.invoke_ns,
+        kind=op.op,
+        node=op.client,
+        fingerprint=f"{target.name}:history:{OP_NAMES[op.op]}",
+    )
+
+
+def triage_seed(
+    target: Target, faults, seed: int, history: bool = False
+) -> Optional[Failure]:
     """Re-run one seed traced and locate its first violating event.
 
     Returns None when the seed does not violate under ``faults`` (the
-    workload's probe never leaves zero) — the caller's signal that a
+    workload's probe never leaves zero — or, with ``history=True``, the
+    decoded op history checks linearizable) — the caller's signal that a
     candidate schedule no longer reproduces."""
     workload, ecfg = target.build(faults)
+    if history:
+        return _triage_history(target, workload, ecfg, seed)
     if workload.probe is None:
         raise ValueError(
             f"target {target.name!r} workload defines no probe; triage "
@@ -71,17 +130,18 @@ def triage_seed(target: Target, faults, seed: int) -> Optional[Failure]:
 
 
 def triage(
-    target: Target, faults, seeds: Sequence[int]
+    target: Target, faults, seeds: Sequence[int], history: bool = False
 ) -> Dict[str, List[Failure]]:
     """Triage a batch of violating seeds into fingerprint buckets.
 
     Returns ``{fingerprint: [Failure, ...]}`` with each bucket's seeds in
     input order; seeds that do not violate are dropped (a campaign's
     violating-seed list can only shrink under re-verification, never
-    grow)."""
+    grow). ``history=True`` routes every seed through the history
+    oracle instead of the model probe."""
     buckets: Dict[str, List[Failure]] = {}
     for seed in seeds:
-        f = triage_seed(target, faults, seed)
+        f = triage_seed(target, faults, seed, history=history)
         if f is not None:
             buckets.setdefault(f.fingerprint, []).append(f)
     return buckets

@@ -97,6 +97,7 @@ def make_sweep_summary(
     # so a new model (or engine counter) can't silently drop them
     engine_fields = (
         ("overflow_seeds", lambda f: jnp.sum(f.overflow)),
+        ("hist_overflow_seeds", lambda f: jnp.sum(f.hist_overflow)),
         ("queue_high_water", lambda f: jnp.max(f.qmax)),
         ("events_total", lambda f: jnp.sum(f.ctr)),
         ("sim_ns_total", lambda f: jnp.sum(f.now_ns)),

@@ -57,30 +57,44 @@ import jax.numpy as jnp
 from ..engine import faults as efaults
 from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
-from ..engine.ops import get1, set1
+from ..engine.ops import get1, get2, set1, set2
 from ..engine.rng import bounded, prob_to_q32
+from ..oracle.history import OP_GET, OP_PUT, PH_INVOKE, PH_OK
 from . import _common
 from ._common import pack_extras, pay as _mkpay
 
 # event kinds
 K_OP = 0  # pay = (client,) — client op timer: send a PUT or GET
 K_KEEPALIVE = 1  # pay = (client,) — client lease-heartbeat timer
-K_MSG = 2  # pay = (dst, mtype, src, a, b, c)
+K_MSG = 2  # pay = (dst, mtype, src, a, b, c, opid)
 K_EXPIRE = 3  # pay = (lease, gen) — server lease-expiry deadline
 K_FAULT = 4  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
 
-# message types
+# message types (slot 6 is the history opid on KV requests/replies, -1
+# on lease traffic — the oracle's completion records key on it)
 MT_LEASE = 0  # grant-or-keepalive; a = lease id
 MT_PUT = 1  # a = key, b = val, c = lease id (-1 = none)
 MT_GET = 2  # a = key
-MT_RSP = 3  # a = revision, b = per-client reply sequence number — replies
-#             are independent datagrams here, but etcd clients read ordered
-#             responses off one gRPC stream, so the monotonicity check
-#             orders replies by the server-assigned sequence (reordered
-#             arrivals are stale and skipped, never mis-flagged)
+MT_RSP = 3  # a = revision, b = per-client reply sequence number, c = op
+#             result (PUT: the value written; GET: value read or -1 =
+#             absent) — replies are independent datagrams here, but etcd
+#             clients read ordered responses off one gRPC stream, so the
+#             monotonicity check orders replies by the server-assigned
+#             sequence (reordered arrivals are stale and skipped, never
+#             mis-flagged)
 
-PAYLOAD_SLOTS = 6
+PAYLOAD_SLOTS = 7
 SERVER = 0
+
+# violation flavors (bitmask latched in ``viol_kind``; ``violation`` stays
+# the any-flavor bool). The explore subsystem's triage keys on these.
+V_REV = 1  # a client observed the revision going backwards
+V_EXPIRY = 2  # a GET observed a key whose lease expired long ago
+
+# pending-op table depth per client (in-flight KV ops awaiting replies,
+# matched by opid; a slot collision just leaves the older op open in the
+# recorded history — sound, the checker treats open ops as optional)
+PEND = 8
 
 
 class EtcdConfig(NamedTuple):
@@ -110,6 +124,16 @@ class EtcdConfig(NamedTuple):
     # deliberate bugs for checker validation
     bug_skip_expiry: bool = False  # expiry handler does nothing
     bug_rev_regress: bool = False  # expiry decrements the revision
+    # GETs serve the key's value as of BEFORE its latest mutation — the
+    # classic stale-read bug. Invisible to the online checkers (revision
+    # and lease bookkeeping stay intact); the history oracle
+    # (madsim_tpu/oracle) catches it as a linearizability breach.
+    bug_stale_read: bool = False
+    # operation-history buffer rows per seed (madsim_tpu/oracle); 0 =
+    # recording off. Ops on the non-lease keys [num_clients, num_keys)
+    # are recorded (lease keys are mutated by server-internal expiry,
+    # which has no client-observed invoke/complete to record).
+    hist_slots: int = 0
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a client-partition spec from the legacy fields above
     faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
@@ -139,6 +163,9 @@ class EtcdState(NamedTuple):
     kv_val: jnp.ndarray  # int32
     kv_mod_rev: jnp.ndarray  # int32
     kv_lease: jnp.ndarray  # int32 (-1 = none)
+    # pre-mutation shadow of each key (bug_stale_read serves from these)
+    kv_prev_present: jnp.ndarray  # bool
+    kv_prev_val: jnp.ndarray  # int32
     rev: jnp.ndarray  # int32 server revision
     # leases [NC] (one slot per client)
     lease_on: jnp.ndarray  # bool
@@ -149,12 +176,21 @@ class EtcdState(NamedTuple):
     # clients [NC]
     seen_rev: jnp.ndarray  # int32 revision of the newest-sequenced reply
     seen_seq: jnp.ndarray  # int32 sequence number of that reply
+    # client op-history bookkeeping (madsim_tpu/oracle): opid allocator
+    # [NC] plus the pending-op table [NC, PEND] the completion record
+    # reads its (op, key, input) back out of, matched by opid
+    next_opid: jnp.ndarray  # int32[NC]
+    pend_id: jnp.ndarray  # int32[NC, PEND] opid in this slot (-1 = free)
+    pend_op: jnp.ndarray  # int32[NC, PEND] OP_PUT / OP_GET
+    pend_key: jnp.ndarray  # int32[NC, PEND]
+    pend_val: jnp.ndarray  # int32[NC, PEND] PUT value (0 for GET)
     # shared liveness/pause/partition/burst state [num_nodes]
     fstate: efaults.FaultState
     # network
     links: enet.LinkState
     # sweep outputs
     violation: jnp.ndarray  # bool
+    viol_kind: jnp.ndarray  # int32 flavor bitmask (V_REV | V_EXPIRY)
     vio_rev: jnp.ndarray  # bool (revision went backwards)
     vio_expiry: jnp.ndarray  # bool (GET saw an expired-lease key)
     puts: jnp.ndarray  # int32
@@ -203,19 +239,34 @@ def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     put_key = jnp.where(own_key, c, key_draw)
     put_lease = jnp.where(own_key, c, jnp.int32(-1))
     val = (rand[4] >> 1).astype(jnp.int32)
+    # history bookkeeping: every request that actually enters the network
+    # claims the client's next opid and parks (op, key, input) in the
+    # pending table; the reply echoes the opid so the completion record
+    # can read the invocation back out (madsim_tpu/oracle)
+    sent = can_send & deliver
+    opid = get1(w.next_opid, c)
+    slot = opid % PEND
+    op_code = jnp.where(is_put, jnp.int32(OP_PUT), jnp.int32(OP_GET))
+    op_key = jnp.where(is_put, put_key, key_draw)
+    op_val = jnp.where(is_put, val, jnp.int32(0))
     msg = jnp.where(
         is_put,
-        _pay(SERVER, MT_PUT, node, put_key, val, put_lease),
-        _pay(SERVER, MT_GET, node, key_draw),
+        _pay(SERVER, MT_PUT, node, put_key, val, put_lease, opid),
+        _pay(SERVER, MT_GET, node, key_draw, 0, 0, opid),
     )
     interval = bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns)
     emits = _emits2(
-        (t, K_MSG, msg, can_send & deliver),
+        (t, K_MSG, msg, sent),
         (now + interval, K_OP, _pay(c), True),
     )
     w2 = w._replace(
+        next_opid=set1(w.next_opid, c, opid + 1, sent),
+        pend_id=set2(w.pend_id, c, slot, opid, sent),
+        pend_op=set2(w.pend_op, c, slot, op_code, sent),
+        pend_key=set2(w.pend_key, c, slot, op_key, sent),
+        pend_val=set2(w.pend_val, c, slot, op_val, sent),
         msgs_sent=w.msgs_sent + jnp.where(can_send, 1, 0),
-        msgs_delivered=w.msgs_delivered + jnp.where(can_send & deliver, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(sent, 1, 0),
     )
     return w2, emits
 
@@ -229,8 +280,10 @@ def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
     interval = bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns)
+    # opid -1: lease traffic carries no history opid, so its reply can
+    # never alias a pending KV op's completion record
     emits = _emits2(
-        (t, K_MSG, _pay(SERVER, MT_LEASE, node, c), can_send & deliver),
+        (t, K_MSG, _pay(SERVER, MT_LEASE, node, c, 0, 0, -1), can_send & deliver),
         (now + interval, K_KEEPALIVE, _pay(c), True),
     )
     w2 = w._replace(
@@ -242,6 +295,7 @@ def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
 
 def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     dst, mtype, src, a, b, c_ = pay[0], pay[1], pay[2], pay[3], pay[4], pay[5]
+    opid = pay[6]
     up = efaults.up(w.fstate)
     at_server = (dst == SERVER) & get1(up, SERVER)
 
@@ -267,6 +321,10 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     lease_live = (put_lease < 0) | get1(lease_on2, safe_put_lease)
     do_put = is_put & lease_live
     rev2 = jnp.where(do_put, w.rev + 1, w.rev)
+    # shadow the pre-mutation value BEFORE overwriting (bug_stale_read
+    # serves GETs from this snapshot)
+    kv_prev_present2 = set1(w.kv_prev_present, key, get1(w.kv_present, key), do_put)
+    kv_prev_val2 = set1(w.kv_prev_val, key, get1(w.kv_val, key), do_put)
     kv_present2 = set1(w.kv_present, key, True, do_put)
     kv_val2 = set1(w.kv_val, key, val, do_put)
     kv_mod_rev2 = set1(w.kv_mod_rev, key, rev2, do_put)
@@ -299,6 +357,15 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     seen2 = set1(w.seen_rev, client, a, newer)
     seen_seq2 = set1(w.seen_seq, client, b, newer)
 
+    # the served value: what this GET tells its client. The stale-read
+    # bug swaps in the pre-mutation shadow — revision and lease
+    # bookkeeping stay intact, so only the history oracle can see it.
+    g_val = jnp.where(g_present, get1(kv_val2, a), jnp.int32(-1))
+    if cfg.bug_stale_read:
+        g_val = jnp.where(
+            get1(kv_prev_present2, a), get1(kv_prev_val2, a), jnp.int32(-1)
+        )
+
     # server replies to every request, stamped with the current revision
     # and the per-client sequence number that orders the client-side check
     rt, rdeliver = enet.route(w.links, now, SERVER, src, rand[0], rand[1])
@@ -306,7 +373,9 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     req_client = jnp.clip(src - 1, 0, cfg.num_clients - 1)
     next_seq = get1(w.rsp_seq, req_client) + 1
     rsp_seq2 = set1(w.rsp_seq, req_client, next_seq, is_req)
-    reply = _pay(src, MT_RSP, SERVER, rev2, next_seq)
+    result = jnp.where(is_get, g_val, jnp.where(is_put, val, jnp.int32(0)))
+    reply_opid = jnp.where(is_put | is_get, opid, jnp.int32(-1))
+    reply = _pay(src, MT_RSP, SERVER, rev2, next_seq, result, reply_opid)
     # fresh expiry deadline for a (re)granted/refreshed lease
     emits = _emits2(
         (rt, K_MSG, reply, is_req & rdeliver),
@@ -321,12 +390,17 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
         kv_val=kv_val2,
         kv_mod_rev=kv_mod_rev2,
         kv_lease=kv_lease2,
+        kv_prev_present=kv_prev_present2,
+        kv_prev_val=kv_prev_val2,
         rsp_seq=rsp_seq2,
         seen_rev=seen2,
         seen_seq=seen_seq2,
         vio_expiry=w.vio_expiry | stale,
         vio_rev=w.vio_rev | regress,
         violation=w.violation | stale | regress,
+        viol_kind=w.viol_kind
+        | jnp.where(stale, jnp.int32(V_EXPIRY), jnp.int32(0))
+        | jnp.where(regress, jnp.int32(V_REV), jnp.int32(0)),
         puts=w.puts + jnp.where(do_put, 1, 0),
         gets=w.gets + jnp.where(is_get, 1, 0),
         keepalives=w.keepalives + jnp.where(is_lease, 1, 0),
@@ -395,6 +469,67 @@ def _handle(cfg: EtcdConfig, w: EtcdState, now, kind, pay, rand):
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
+def _probe(w: EtcdState):
+    """Violation-flavor bitmask (engine contract: ``Workload.probe``) —
+    recorded per step by ``run_traced`` so triage can locate the first
+    violating event."""
+    return w.viol_kind
+
+
+def _record(cfg: EtcdConfig, wb: EtcdState, wa: EtcdState, now, kind, pay):
+    """Map one dispatched event to its op-history record (engine
+    contract: ``Workload.record`` — at most ONE row per event).
+
+    Two row sources, mutually exclusive by event kind: a K_OP timer that
+    actually put a request on the wire writes the op's INVOKE row (the
+    fields were just parked in the pending table), and a delivered
+    MT_RSP whose echoed opid still matches its pending slot writes the
+    OK row. Only ops on the non-lease keys [num_clients, num_keys) are
+    recorded: lease keys are mutated by server-internal expiry, which no
+    client observes, so their subhistories would be uncheckable."""
+    nc = cfg.num_clients
+
+    # invoke side: the op timer bumped this client's opid allocator
+    c = jnp.clip(pay[0], 0, nc - 1)
+    inv_opid = get1(wb.next_opid, c)
+    sent = (kind == K_OP) & (get1(wa.next_opid, c) > inv_opid)
+    slot = inv_opid % PEND
+    inv_op = get2(wa.pend_op, c, slot)
+    inv_key = get2(wa.pend_key, c, slot)
+    inv_val = get2(wa.pend_val, c, slot)
+    inv_en = sent & (inv_key >= nc)
+
+    # completion side: a delivered KV reply matching its pending slot
+    dst, mtype, result, opid = pay[0], pay[1], pay[5], pay[6]
+    rc = jnp.clip(dst - 1, 0, nc - 1)
+    is_rsp = (
+        (kind == K_MSG)
+        & (mtype == MT_RSP)
+        & (dst >= 1)
+        & get1(efaults.up(wb.fstate), jnp.clip(dst, 0, cfg.num_nodes - 1))
+        & (opid >= 0)
+    )
+    rslot = jnp.clip(opid, 0, jnp.int32(2**30)) % PEND
+    rsp_op = get2(wb.pend_op, rc, rslot)
+    rsp_key = get2(wb.pend_key, rc, rslot)
+    matched = is_rsp & (get2(wb.pend_id, rc, rslot) == opid)
+    ok_en = matched & (rsp_key >= nc)
+
+    def col(inv, ok):
+        return jnp.where(inv_en, jnp.asarray(inv, jnp.int32), jnp.asarray(ok, jnp.int32))
+
+    rec = jnp.stack(
+        [
+            col(c, rc),
+            col(inv_op * 2 + PH_INVOKE, rsp_op * 2 + PH_OK),
+            col(inv_key, rsp_key),
+            col(inv_val, result),
+            col(inv_opid, opid),
+        ]
+    )
+    return rec, inv_en | ok_en
+
+
 def _init(cfg: EtcdConfig, key):
     nc = cfg.num_clients
     if cfg.num_keys < nc:
@@ -408,6 +543,8 @@ def _init(cfg: EtcdConfig, key):
         kv_val=jnp.zeros((cfg.num_keys,), jnp.int32),
         kv_mod_rev=jnp.zeros((cfg.num_keys,), jnp.int32),
         kv_lease=jnp.full((cfg.num_keys,), -1, jnp.int32),
+        kv_prev_present=jnp.zeros((cfg.num_keys,), bool),
+        kv_prev_val=jnp.zeros((cfg.num_keys,), jnp.int32),
         rev=jnp.zeros((), jnp.int32),
         lease_on=jnp.zeros((nc,), bool),
         lease_exp=jnp.zeros((nc,), jnp.int64),
@@ -415,12 +552,18 @@ def _init(cfg: EtcdConfig, key):
         rsp_seq=jnp.zeros((nc,), jnp.int32),
         seen_rev=jnp.zeros((nc,), jnp.int32),
         seen_seq=jnp.zeros((nc,), jnp.int32),
+        next_opid=jnp.zeros((nc,), jnp.int32),
+        pend_id=jnp.full((nc, PEND), -1, jnp.int32),
+        pend_op=jnp.zeros((nc, PEND), jnp.int32),
+        pend_key=jnp.zeros((nc, PEND), jnp.int32),
+        pend_val=jnp.zeros((nc, PEND), jnp.int32),
         fstate=efaults.init_state(cfg.num_nodes),
         links=enet.make(
             cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
             cfg.buggify_q32,
         ),
         violation=jnp.zeros((), bool),
+        viol_kind=jnp.zeros((), jnp.int32),
         vio_rev=jnp.zeros((), bool),
         vio_expiry=jnp.zeros((), bool),
         puts=jnp.zeros((), jnp.int32),
@@ -469,6 +612,9 @@ def workload(cfg: EtcdConfig = None) -> Workload:
         num_rand=6,
         payload_slots=PAYLOAD_SLOTS,
         max_emits=2,
+        probe=_probe,
+        record=partial(_record, cfg) if cfg.hist_slots > 0 else None,
+        hist_slots=cfg.hist_slots,
     )
 
 

@@ -53,6 +53,7 @@ from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, set1, set2
 from ..engine.rng import bounded, prob_to_q32
+from ..oracle.history import OP_FETCH, OP_PRODUCE, PH_INVOKE, PH_OK
 from . import _common
 
 # event kinds
@@ -62,7 +63,8 @@ K_MSG = 2  # pay = (dst_node, mtype, src_node, a, b, c)
 K_FLUSH = 3  # pay = (bgen,) — broker durability timer
 K_FAULT = 4  # pay = (action, victim, t_lo, t_hi) — engine/faults.py stream
 
-# message types (pay slots a/b/c per type)
+# message types (pay slots a/b/c per type; slot 5 carries the history
+# opid on fetch traffic — see _record)
 MT_PRODUCE = 0  # a = seq
 MT_ACK = 1  # a = ack_upto (cumulative)
 MT_FETCH = 2  # a = offset
@@ -70,6 +72,11 @@ MT_FETCH_RSP = 3  # a = start_offset, b = num_records
 
 PAYLOAD_SLOTS = 6
 BROKER = 0  # node id of the broker
+
+# violation flavors (bitmask latched in ``viol_kind``; ``violation`` stays
+# the any-flavor bool). The explore subsystem's triage keys on these.
+V_ACK_LOSS = 1  # a crash lost messages the broker had acknowledged
+V_WATERMARK = 2  # the durable watermark exceeded the log end
 
 
 class KafkaConfig(NamedTuple):
@@ -103,6 +110,10 @@ class KafkaConfig(NamedTuple):
     # deliberate bug for checker validation: ack on append instead of at
     # flush — crash between append and flush loses acknowledged messages
     bug_ack_on_append: bool = False
+    # operation-history buffer rows per seed (madsim_tpu/oracle); 0 =
+    # recording off. Records produce sends/acks and fetch polls/matches
+    # for the ordered-log spec (oracle/specs.LogSpec).
+    hist_slots: int = 0
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a broker-crash spec from the legacy fields above
     faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
@@ -140,12 +151,15 @@ class KafkaState(NamedTuple):
     dur_upto: jnp.ndarray  # int32 highest seq with a durable copy
     # producers [NP]
     next_seq: jnp.ndarray  # int32 lowest unacked seq (== M when done)
+    prod_sends: jnp.ndarray  # int32 produce messages actually on the wire
     # consumers [NC]
     cons_off: jnp.ndarray  # int32 next offset to fetch
+    cons_opid: jnp.ndarray  # int32 history opid allocator (fetch ops)
     # network
     links: enet.LinkState
     # sweep outputs
     violation: jnp.ndarray  # bool (any checker)
+    viol_kind: jnp.ndarray  # int32 flavor bitmask (V_ACK_LOSS | V_WATERMARK)
     vio_ack_loss: jnp.ndarray  # bool
     vio_watermark: jnp.ndarray  # bool
     log_overflow: jnp.ndarray  # bool
@@ -206,6 +220,10 @@ def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     )
     w2 = w._replace(
         produced=w.produced + jnp.where(active, 1, 0),
+        # on-the-wire counter: the record hook's invoke marker (a send
+        # that the network dropped never reached the broker, so it is
+        # not an op the history needs to explain)
+        prod_sends=set1(w.prod_sends, p, get1(w.prod_sends, p) + 1, send),
         msgs_sent=w.msgs_sent + jnp.where(active, 1, 0),
         msgs_delivered=w.msgs_delivered + jnp.where(send, 1, 0),
     )
@@ -219,17 +237,20 @@ def _on_fetch_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     node = _consumer_node(cfg, c)
     can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
-    msg = _pay(BROKER, MT_FETCH, node, get1(w.cons_off, c))
+    sent = can_send & deliver
+    opid = get1(w.cons_opid, c)
+    msg = _pay(BROKER, MT_FETCH, node, get1(w.cons_off, c), 0, opid)
     interval = bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns)
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (t, K_MSG, msg, can_send & deliver),
+        (t, K_MSG, msg, sent),
         (now + interval, K_FETCH, _pay(c), True),
     )
     w2 = w._replace(
+        cons_opid=set1(w.cons_opid, c, opid + 1, sent),
         msgs_sent=w.msgs_sent + jnp.where(can_send, 1, 0),
-        msgs_delivered=w.msgs_delivered + jnp.where(can_send & deliver, 1, 0),
+        msgs_delivered=w.msgs_delivered + jnp.where(sent, 1, 0),
     )
     return w2, emits
 
@@ -317,7 +338,8 @@ def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     rt, rdeliver = enet.route(w.links, now, BROKER, src, rand[0], rand[1])
     reply_pay = jnp.where(
         is_fetch,
-        _pay(src, MT_FETCH_RSP, BROKER, off, nrec),
+        # slot 5 echoes the fetch's history opid back to the consumer
+        _pay(src, MT_FETCH_RSP, BROKER, off, nrec, pay[5]),
         _pay(src, MT_ACK, BROKER, new_ack_p),
     )
     reply_on = (is_fetch | send_ack) & rdeliver
@@ -407,6 +429,8 @@ def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
         flushes=w.flushes + jnp.where(valid, 1, 0),
         vio_watermark=w.vio_watermark | bad_wm,
         violation=w.violation | bad_wm,
+        viol_kind=w.viol_kind
+        | jnp.where(bad_wm, jnp.int32(V_WATERMARK), jnp.int32(0)),
         msgs_sent=w.msgs_sent + jnp.sum(slot_adv, dtype=jnp.int32),
         msgs_delivered=w.msgs_delivered + jnp.sum(enables, dtype=jnp.int32),
     )
@@ -448,6 +472,9 @@ def _on_fault(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
         vio_ack_loss=w.vio_ack_loss | (crashed & lost_acked),
         vio_watermark=w.vio_watermark | (crashed & bad_wm),
         violation=w.violation | (crashed & (lost_acked | bad_wm)),
+        viol_kind=w.viol_kind
+        | jnp.where(crashed & lost_acked, jnp.int32(V_ACK_LOSS), jnp.int32(0))
+        | jnp.where(crashed & bad_wm, jnp.int32(V_WATERMARK), jnp.int32(0)),
         crash_count=w.crash_count + jnp.where(crashed, 1, 0),
     )
     emits = _emits(
@@ -470,6 +497,85 @@ def _handle(cfg: KafkaConfig, w: KafkaState, now, kind, pay, rand):
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
+def _probe(w: KafkaState):
+    """Violation-flavor bitmask (engine contract: ``Workload.probe``) —
+    recorded per step by ``run_traced`` so triage can locate the first
+    violating event."""
+    return w.viol_kind
+
+
+def _record(cfg: KafkaConfig, wb: KafkaState, wa: KafkaState, now, kind, pay):
+    """Map one dispatched event to its op-history record (engine
+    contract: ``Workload.record`` — at most ONE row per event).
+
+    History clients: producers are 0..NP-1, consumers NP..NP+NC-1. A
+    produce send's opid is its seq (retries re-invoke the same id; the
+    decoder keeps the superseded invoke open, which the checker treats
+    as optional — sound). A cumulative ack that advances the producer's
+    frontier completes the frontier seq; skipped seqs stay open. Fetches
+    use a per-consumer opid echoed through pay slot 5, completed only on
+    the offset-matching response — so recorded completions are exactly
+    the committed ones, which is what LogSpec's contiguity pre-check
+    keys on."""
+    np_ = cfg.num_producers
+
+    # produce invoke: the timer put seq on the wire (prod_sends bumped)
+    p = jnp.clip(pay[0], 0, np_ - 1)
+    p_sent = (kind == K_PRODUCE) & (
+        get1(wa.prod_sends, p) > get1(wb.prod_sends, p)
+    )
+    p_seq = get1(wb.next_seq, p)
+
+    # fetch invoke: the poll timer sent (cons_opid bumped)
+    c = jnp.clip(pay[0], 0, cfg.num_consumers - 1)
+    f_sent = (kind == K_FETCH) & (
+        get1(wa.cons_opid, c) > get1(wb.cons_opid, c)
+    )
+    f_opid = get1(wb.cons_opid, c)
+    f_off = get1(wb.cons_off, c)
+
+    # completions ride on delivered K_MSG events at the clients
+    dst, mtype, a, b = pay[0], pay[1], pay[3], pay[4]
+    ack_p = jnp.clip(dst - 1, 0, np_ - 1)
+    acked = (kind == K_MSG) & (mtype == MT_ACK) & (
+        get1(wa.next_seq, ack_p) > get1(wb.next_seq, ack_p)
+    )
+    rsp_c = jnp.clip(dst - 1 - np_, 0, cfg.num_consumers - 1)
+    matched = (
+        (kind == K_MSG)
+        & (mtype == MT_FETCH_RSP)
+        & (get1(wa.cons_off, rsp_c) > get1(wb.cons_off, rsp_c))
+    )
+
+    def pick(pv, fv, av, mv):
+        pv, fv = jnp.asarray(pv, jnp.int32), jnp.asarray(fv, jnp.int32)
+        av, mv = jnp.asarray(av, jnp.int32), jnp.asarray(mv, jnp.int32)
+        return jnp.where(
+            p_sent, pv, jnp.where(f_sent, fv, jnp.where(acked, av, mv))
+        )
+
+    rec = jnp.stack(
+        [
+            pick(p, np_ + c, ack_p, np_ + rsp_c),
+            pick(
+                OP_PRODUCE * 2 + PH_INVOKE,
+                OP_FETCH * 2 + PH_INVOKE,
+                OP_PRODUCE * 2 + PH_OK,
+                OP_FETCH * 2 + PH_OK,
+            ),
+            pick(
+                p % cfg.partitions,
+                c % cfg.partitions,
+                ack_p % cfg.partitions,
+                rsp_c % cfg.partitions,
+            ),
+            pick(p_seq, f_off, a, b),
+            pick(p_seq, f_opid, a, pay[5]),
+        ]
+    )
+    return rec, p_sent | f_sent | acked | matched
+
+
 def _init(cfg: KafkaConfig, key):
     np_, nc = cfg.num_producers, cfg.num_consumers
     ninit = np_ + nc + 1
@@ -486,12 +592,15 @@ def _init(cfg: KafkaConfig, key):
         ack_upto=jnp.full((np_,), -1, jnp.int32),
         dur_upto=jnp.full((np_,), -1, jnp.int32),
         next_seq=jnp.zeros((np_,), jnp.int32),
+        prod_sends=jnp.zeros((np_,), jnp.int32),
         cons_off=jnp.zeros((nc,), jnp.int32),
+        cons_opid=jnp.zeros((nc,), jnp.int32),
         links=enet.make(
             cfg.num_nodes, cfg.loss_q32, cfg.lat_lo_ns, cfg.lat_hi_ns,
             cfg.buggify_q32,
         ),
         violation=jnp.zeros((), bool),
+        viol_kind=jnp.zeros((), jnp.int32),
         vio_ack_loss=jnp.zeros((), bool),
         vio_watermark=jnp.zeros((), bool),
         log_overflow=jnp.zeros((), bool),
@@ -544,6 +653,9 @@ def workload(cfg: KafkaConfig = None) -> Workload:
         num_rand=2 * cfg.num_nodes + 3,
         payload_slots=PAYLOAD_SLOTS,
         max_emits=cfg.num_nodes + 2,
+        probe=_probe,
+        record=partial(_record, cfg) if cfg.hist_slots > 0 else None,
+        hist_slots=cfg.hist_slots,
     )
 
 

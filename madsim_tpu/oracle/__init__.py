@@ -1,0 +1,49 @@
+"""History oracle: generic consistency checking for sweep workloads.
+
+Every oracle the sweep stack had before this package was a hand-coded,
+model-specific invariant latch (`raft.viol_kind`, etcd's online revision
+and lease checks) — a bug that does not trip a pre-written probe is
+invisible. The Jepsen-style alternative is generic: record the
+client-observed **operation history** and check it against the
+datatype's **sequential specification** (Wing & Gong linearizability;
+the Porcupine/WGL checker family). Three pieces:
+
+- ``history`` — decode the engine's per-seed op-record ring buffer
+  (``EngineState.hist_*``, written in-step by ``Workload.record``) into
+  paired invoke/complete operations, plus a thin client-shim for
+  recording host-tier histories in the same format;
+- ``specs`` — pluggable sequential specs (KV register for etcd,
+  per-partition ordered log for kafka);
+- ``check`` — a WGL-style linearizability search with memoized state
+  hashing, per-key partitioning, and first-bad-prefix location.
+
+See docs/oracle.md for the record-hook contract and complexity caveats.
+"""
+
+from .check import CheckResult, check_history, first_bad_prefix, violating_seeds
+from .history import (
+    OP_NAMES,
+    History,
+    HostRecorder,
+    Op,
+    decode_seed,
+    decode_sweep,
+    history_bytes,
+)
+from .specs import KVSpec, LogSpec
+
+__all__ = [
+    "CheckResult",
+    "check_history",
+    "first_bad_prefix",
+    "violating_seeds",
+    "OP_NAMES",
+    "History",
+    "HostRecorder",
+    "Op",
+    "decode_seed",
+    "decode_sweep",
+    "history_bytes",
+    "KVSpec",
+    "LogSpec",
+]

@@ -1,0 +1,244 @@
+"""Operation histories: decode device ring buffers, record host-tier runs.
+
+The device engine appends one fixed-width record per dispatched event
+that the workload's ``record`` hook elects (engine/core.py): five int32
+columns ``(client, code, key, val, opid)`` plus an engine-stamped int64
+virtual time. ``code`` packs an op kind and a phase —
+``code = op * 2 + phase`` — so one client-visible operation is TWO rows
+(its invoke at send time, its completion at response-delivery time),
+matched by ``(client, opid)``. One row per event is exactly what the
+engine's one-masked-write-per-step discipline can afford, and the
+invoke/ok pairing is the Jepsen history shape the checker wants anyway.
+
+``decode_seed`` turns a finished ``EngineState`` lane back into ``Op``
+records; ``history_bytes`` is the canonical byte encoding the
+determinism gate diffs (same ``(spec, seed)`` on the sweep path and on
+the bit-exact CPU ``run_traced`` replay path must produce identical
+bytes). ``HostRecorder`` is the thin client-shim for the host tier: wrap
+each client call in ``invoke``/``complete`` and the host run yields the
+same ``History`` structure, checkable by the same specs.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, List, NamedTuple, Optional, Tuple
+
+import numpy as np
+
+# op kinds (the row's code column is ``op * 2 + phase``)
+OP_PUT = 0  # key := inp; out echoes inp
+OP_GET = 1  # read key; out = value or -1 (absent)
+OP_DEL = 2  # delete key (internal ops record invoke == complete)
+OP_PRODUCE = 3  # append inp (seq) to log/partition key; out = ack frontier
+OP_FETCH = 4  # read from offset inp of partition key; out = records served
+
+OP_NAMES = ("put", "get", "del", "produce", "fetch")
+
+PH_INVOKE = 0
+PH_OK = 1
+
+
+def code_of(op: int, phase: int) -> int:
+    """The row code the record hooks write: ``op * 2 + phase``."""
+    return op * 2 + phase
+
+
+class Op(NamedTuple):
+    """One client-observed operation, paired from its invoke/ok rows."""
+
+    client: int
+    op: int  # OP_*
+    key: int  # key (KV) or partition (log)
+    inp: int  # invoke argument: PUT value / produce seq / fetch offset
+    out: int  # completion result (meaningless while ``complete_ns < 0``)
+    invoke_ns: int
+    complete_ns: int  # -1 = never completed (open op — may have happened)
+    opid: int
+
+    @property
+    def complete(self) -> bool:
+        return self.complete_ns >= 0
+
+    def describe(self) -> str:
+        done = f"-> {self.out} @{self.complete_ns}" if self.complete else "-> ?"
+        return (
+            f"c{self.client} {OP_NAMES[self.op]}(k={self.key}, {self.inp}) "
+            f"@{self.invoke_ns} {done}"
+        )
+
+
+class History(NamedTuple):
+    """A decoded per-seed operation history."""
+
+    seed: int
+    ops: Tuple[Op, ...]  # invoke order (== record-append order)
+    overflow: bool  # buffer filled up: ops is a valid strict prefix
+    rows: int  # raw rows consumed
+
+
+def _pair_rows(rec: np.ndarray, t: np.ndarray, n: int) -> Tuple[Op, ...]:
+    """Pair invoke/ok rows by (client, opid) into ``Op`` records.
+
+    Rows are appended in dispatch order, so an op's invoke row always
+    precedes its ok row; an ok row with no recorded invoke means the
+    decoder and the workload's record hook disagree — that is a bug, not
+    a data condition, so it raises."""
+    ops: List[List] = []
+    open_ops = {}  # (client, opid) -> index into ops
+    for i in range(n):
+        client, code, key, val, opid = (int(v) for v in rec[i])
+        op, phase = code // 2, code % 2
+        when = int(t[i])
+        if phase == PH_INVOKE:
+            open_ops[(client, opid)] = len(ops)
+            ops.append([client, op, key, val, 0, when, -1, opid])
+        else:
+            j = open_ops.pop((client, opid), None)
+            if j is None:
+                raise ValueError(
+                    f"history row {i} completes op (client={client}, "
+                    f"opid={opid}) with no recorded invoke — record-hook "
+                    "contract breach"
+                )
+            if ops[j][1] != op or ops[j][2] != key:
+                raise ValueError(
+                    f"history row {i} completes (client={client}, "
+                    f"opid={opid}) with mismatched op/key "
+                    f"({op}/{key} vs {ops[j][1]}/{ops[j][2]})"
+                )
+            ops[j][4] = val
+            ops[j][6] = when
+    return tuple(Op(*o) for o in ops)
+
+
+def decode_rows(
+    rec, t, length, overflow, seed: int = -1
+) -> History:
+    """Decode one seed's raw history arrays (any source) into a History."""
+    rec = np.asarray(rec)
+    t = np.asarray(t)
+    n = int(length)
+    return History(
+        seed=int(seed),
+        ops=_pair_rows(rec, t, n),
+        overflow=bool(overflow),
+        rows=n,
+    )
+
+
+def decode_seed(final, lane: Optional[int] = None) -> History:
+    """Decode the history buffer of a finished ``EngineState``.
+
+    ``final`` is unbatched (``run_traced``'s final state) when ``lane``
+    is None, else a batched sweep state indexed by ``lane``."""
+    if lane is None:
+        return decode_rows(
+            final.hist_rec, final.hist_t, final.hist_len,
+            final.hist_overflow, seed=int(final.seed),
+        )
+    return decode_rows(
+        np.asarray(final.hist_rec)[lane],
+        np.asarray(final.hist_t)[lane],
+        np.asarray(final.hist_len)[lane],
+        np.asarray(final.hist_overflow)[lane],
+        seed=int(np.asarray(final.seed)[lane]),
+    )
+
+
+def decode_sweep(final) -> List[History]:
+    """Decode every lane of a batched sweep state (host-side loop; pull
+    the arrays off the device once, not per lane)."""
+    rec = np.asarray(final.hist_rec)
+    t = np.asarray(final.hist_t)
+    length = np.asarray(final.hist_len)
+    ov = np.asarray(final.hist_overflow)
+    seeds = np.asarray(final.seed)
+    return [
+        decode_rows(rec[i], t[i], length[i], ov[i], seed=int(seeds[i]))
+        for i in range(seeds.shape[0])
+    ]
+
+
+def history_bytes(hist: History) -> bytes:
+    """Canonical byte encoding of a decoded history.
+
+    The determinism contract (docs/oracle.md): the same ``(spec, seed)``
+    decoded from a device sweep lane and from a bit-exact CPU
+    ``run_traced`` replay — or from two separate processes — must
+    produce identical bytes. No wall times, no paths, no float repr."""
+    lines = [f"seed={hist.seed} rows={hist.rows} overflow={int(hist.overflow)}"]
+    lines += [
+        f"c={o.client} op={OP_NAMES[o.op]} key={o.key} in={o.inp} "
+        f"out={o.out if o.complete else '?'} "
+        f"t=[{o.invoke_ns},{o.complete_ns}] id={o.opid}"
+        for o in hist.ops
+    ]
+    return ("\n".join(lines) + "\n").encode()
+
+
+class HostRecorder:
+    """Thin client-shim recording host-tier operation histories.
+
+    The host tier runs arbitrary async Python under the same virtual
+    clock; wrapping each client call in ``invoke``/``complete`` yields
+    the same ``History`` structure the device decoder produces, so one
+    checker serves both tiers::
+
+        rec = HostRecorder()
+        opid = rec.invoke(client=0, op=OP_PUT, key=3, inp=42)
+        resp = await kv.put(b"k3", b"42")
+        rec.complete(client=0, opid=opid, out=42)
+        check_history(rec.history(), KVSpec())
+
+    Times default to the running simulation's virtual clock
+    (``madsim_tpu.time``); pass ``clock`` (a ``() -> int`` of
+    nanoseconds) to record outside a sim context. NOTE: two engines
+    cannot share one RNG stream, so a host history for a ``(spec,
+    seed)`` is *not* byte-comparable to the device history — byte
+    identity is the contract between the device sweep and its CPU
+    ``run_traced`` replay; host histories share only the format and the
+    checker (docs/oracle.md).
+    """
+
+    def __init__(self, clock: Optional[Callable[[], int]] = None):
+        if clock is None:
+            def clock() -> int:
+                from ..context import current_handle
+
+                return int(current_handle().time.now_ns)
+
+        self._clock = clock
+        self._rows: List[Tuple[int, int, int, int, int, int]] = []
+        self._next_opid = {}
+        self._open = {}  # (client, opid) -> (invoke code, key)
+
+    def invoke(self, client: int, op: int, key: int, inp: int) -> int:
+        """Record an op's invocation; returns the opid to complete with."""
+        opid = self._next_opid.get(client, 0)
+        self._next_opid[client] = opid + 1
+        code = code_of(op, PH_INVOKE)
+        self._open[(client, opid)] = (code, key)
+        self._rows.append((client, code, key, inp, opid, self._clock()))
+        return opid
+
+    def complete(self, client: int, opid: int, out: int) -> None:
+        """Record an op's completion (skip for ops that never returned).
+        Completing an unknown or already-completed op raises HERE, at
+        the offending call, not later from the decoder."""
+        # the op/key columns are reconstructed from the invoke entry, so
+        # completion needs only the identity and the result
+        entry = self._open.pop((client, opid), None)
+        if entry is None:
+            raise ValueError(
+                f"complete() for unknown or already-completed "
+                f"(client={client}, opid={opid})"
+            )
+        code, key = entry
+        self._rows.append((client, code + 1, key, out, opid, self._clock()))
+
+    def history(self, seed: int = -1) -> History:
+        rec = np.asarray(
+            [r[:5] for r in self._rows], dtype=np.int32
+        ).reshape(len(self._rows), 5)
+        t = np.asarray([r[5] for r in self._rows], dtype=np.int64)
+        return decode_rows(rec, t, len(self._rows), False, seed=seed)

@@ -1,0 +1,139 @@
+"""Sequential specifications the linearizability checker runs against.
+
+A spec answers one question: *is this operation's observed result legal
+as the next atomic step of the datatype?* The checker (oracle/check.py)
+searches over linearization orders; the spec supplies the datatype's
+sequential semantics through three methods:
+
+- ``init() -> state`` — the initial abstract state. States must be
+  **hashable** (the WGL search memoizes on ``(linearized-set, state)``).
+- ``apply(state, op) -> (ok, state2)`` — attempt ``op`` as the next
+  atomic step. For a completed op, ``ok`` demands the observed result
+  matches; an open op (no completion recorded) has no observation to
+  contradict, so ``ok`` is True and only the state effect applies.
+- ``partition_of(op) -> key`` — linearizability is compositional over
+  independent objects (the Herlihy–Wing locality theorem), so the
+  checker verifies each partition's subhistory independently — the
+  difference between exponential-in-history and exponential-in-
+  per-key-contention.
+
+``structural(ops)`` is an optional pre-pass for invariants that are
+per-client and order-based rather than value-based (kafka's
+committed-offset monotonicity) — cheap, and failures there skip the
+search entirely.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .history import OP_DEL, OP_FETCH, OP_GET, OP_PRODUCE, OP_PUT, Op
+
+ABSENT = -1  # the value-column encoding of "key not present"
+
+
+class Spec:
+    """Base sequential spec; subclasses override the three methods."""
+
+    name = "spec"
+
+    def init(self):
+        raise NotImplementedError
+
+    def apply(self, state, op: Op):
+        raise NotImplementedError
+
+    def partition_of(self, op: Op) -> int:
+        return 0
+
+    def structural(self, ops: Sequence[Op]) -> Optional[Tuple[int, str]]:
+        """Order-based pre-check; return ``(op index, reason)`` on breach."""
+        return None
+
+    def partition(self, ops: Sequence[Op]) -> Dict[int, List[Tuple[int, Op]]]:
+        """Group ops by partition key, keeping each op's global index."""
+        parts: Dict[int, List[Tuple[int, Op]]] = {}
+        for i, op in enumerate(ops):
+            parts.setdefault(self.partition_of(op), []).append((i, op))
+        return parts
+
+
+class KVSpec(Spec):
+    """A map of independent int registers — the etcd KV sequential spec.
+
+    Per-key state is the register value (``ABSENT`` when unset). PUT
+    writes, GET must observe exactly the current value, DEL (the etcd
+    model's internal lease-expiry deletions, recorded as server ops with
+    invoke == complete) unsets. One key = one partition, so the search
+    only ever weighs genuinely-concurrent ops on the same key.
+    """
+
+    name = "kv"
+
+    def init(self):
+        return ABSENT
+
+    def apply(self, state, op: Op):
+        if op.op == OP_PUT:
+            return True, op.inp
+        if op.op == OP_DEL:
+            return True, ABSENT
+        if op.op == OP_GET:
+            ok = (not op.complete) or op.out == state
+            return ok, state
+        return False, state
+
+    def partition_of(self, op: Op) -> int:
+        return op.key
+
+
+class LogSpec(Spec):
+    """Per-partition ordered log — the kafka sequential spec.
+
+    Per-partition state is the number of appended records. PRODUCE
+    appends one record (retries are separate invokes and separate
+    appends — the device broker does not dedupe); a completed
+    FETCH(offset) that served ``out`` records requires ``offset + out``
+    records to already exist — a broker serving records no linearized
+    produce could have appended is the violation.
+
+    ``structural`` adds committed-offset monotonicity: the device client
+    only records a fetch completion when the response matched its
+    position, so each consumer's completed fetches must advance its
+    offset contiguously — ``offset[i+1] == offset[i] + served[i]`` in
+    completion order, never backwards.
+    """
+
+    name = "log"
+
+    def init(self):
+        return 0
+
+    def apply(self, state, op: Op):
+        if op.op == OP_PRODUCE:
+            return True, state + 1
+        if op.op == OP_FETCH:
+            ok = (not op.complete) or (op.inp + op.out <= state)
+            return ok, state
+        return False, state
+
+    def partition_of(self, op: Op) -> int:
+        return op.key
+
+    def structural(self, ops: Sequence[Op]) -> Optional[Tuple[int, str]]:
+        pos: Dict[Tuple[int, int], int] = {}  # (client, partition) -> offset
+        done = [
+            (op.complete_ns, i, op)
+            for i, op in enumerate(ops)
+            if op.op == OP_FETCH and op.complete
+        ]
+        for _, i, op in sorted(done):
+            expect = pos.get((op.client, op.key), 0)
+            if op.inp != expect:
+                return i, (
+                    f"consumer {op.client} offset broke contiguity on "
+                    f"partition {op.key}: fetched at {op.inp}, committed "
+                    f"offset was {expect}"
+                )
+            pos[(op.client, op.key)] = op.inp + op.out
+        return None

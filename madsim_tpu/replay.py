@@ -95,6 +95,28 @@ def extract_fault_schedule(trace: Dict, fault_kind: int) -> List[FaultEvent]:
     return sorted(plan)
 
 
+def extract_history(final, lane: Optional[int] = None):
+    """Decode the recorded operation history out of a replay's final
+    state (``oracle.History``) — the history-oracle counterpart of
+    ``extract_fault_schedule``. ``final`` is ``run_traced``'s final state
+    (unbatched), or a batched sweep state with ``lane`` set; either way
+    the decoded ops are byte-identical across the two paths for one
+    seed (``oracle.history_bytes`` is the canonical encoding the
+    determinism gate diffs)."""
+    from .oracle.history import decode_seed
+
+    return decode_seed(final, lane)
+
+
+def history_violation_seeds(final, spec) -> np.ndarray:
+    """Seeds of a finished sweep whose decoded history fails the
+    linearizability check against ``spec`` — the generic-oracle
+    counterpart of ``violation_seeds`` (no hand-coded probe needed)."""
+    from .oracle.check import violating_seeds
+
+    return violating_seeds(final, spec)
+
+
 def replay_on_host(
     run_with_plan: Callable[[int, Sequence[FaultEvent]], Dict],
     plan: Sequence[FaultEvent],

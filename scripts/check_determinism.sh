@@ -6,6 +6,10 @@
 # derivation, the engine loop, or the fault interpreter fails the gate.
 # A second leg runs a tiny explore campaign twice the same way and
 # byte-diffs the JSONL reports (docs/explore.md determinism contract).
+# The dump also decodes etcd operation histories (madsim_tpu/oracle) on
+# both the sweep and the traced-replay path, so the same (spec, seed)
+# must yield byte-identical canonical history bytes across the two
+# processes AND across the two paths (docs/oracle.md contract).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -45,6 +49,26 @@ for seed in (0, 7):
     _, trace = core.run_traced(wl, ecfg, seed)
     for k in sorted(trace):
         blobs[f"trace{seed}.{k}"] = np.asarray(trace[k])
+
+# history leg (madsim_tpu/oracle): decoded op histories for one etcd
+# (spec, seed) set — canonical bytes from the sweep path, asserted
+# in-process equal to the traced-replay path's (cross-path identity),
+# then byte-diffed across the two processes by the npz cmp below. The
+# (config, faults) pair is the oracle pipeline's own (clean control),
+# so this gate covers exactly what oracle_demo/replay_seed run.
+from madsim_tpu.explore.targets import oracle_demo_faults, stale_etcd_target
+from madsim_tpu.oracle import decode_seed, history_bytes
+
+wl2, ecfg2 = stale_etcd_target(bug_stale_read=False).build(oracle_demo_faults())
+hfinal = core.run_sweep(wl2, ecfg2, jnp.arange(16, dtype=jnp.int64))
+for seed in (0, 5, 11):
+    sweep_b = history_bytes(decode_seed(hfinal, seed))
+    tfinal, _ = core.run_traced(wl2, ecfg2, seed)
+    assert history_bytes(decode_seed(tfinal)) == sweep_b, (
+        f"history path divergence at seed {seed}: sweep lane != traced replay"
+    )
+    blobs[f"hist{seed}"] = np.frombuffer(sweep_b, dtype=np.uint8)
+
 np.savez(sys.argv[1], **blobs)
 print(f"wrote {len(blobs)} arrays -> {sys.argv[1]}")
 EOF
@@ -56,7 +80,7 @@ dump "$out/b.npz"
 # npz member timestamps are zeroed by numpy, so the archives themselves
 # must be byte-identical when every array is
 if cmp -s "$out/a.npz" "$out/b.npz"; then
-  echo "determinism gate: OK (two processes, byte-identical traces)"
+  echo "determinism gate: OK (two processes, byte-identical traces + histories)"
 
   # explore leg: two campaign runs of one campaign seed must emit
   # byte-identical JSONL reports (no shrink — this leg checks the

@@ -1,0 +1,350 @@
+"""History oracle (madsim_tpu/oracle): recording plane + WGL checker.
+
+Covers the subsystem's contracts bottom-up: the checker on hand-written
+histories (known-linearizable and known-not), the engine's history
+buffer (in-step append, sticky no-wrap overflow), validation that the
+checker FIRES on a seeded etcd bug and stays clean on the default
+config over pinned seed ranges, cross-path byte identity (device-sweep
+lane vs bit-exact CPU traced replay), the explore wiring (history
+triage flavor + checker-verified shrink), the etcd/kafka ``viol_kind``
+flavor parity, and the host-tier recorder shim.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import explore, replay
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.faults import FaultSpec
+from madsim_tpu.models import etcd, kafka
+from madsim_tpu.oracle import (
+    History,
+    HostRecorder,
+    KVSpec,
+    LogSpec,
+    Op,
+    check_history,
+    decode_seed,
+    decode_sweep,
+    first_bad_prefix,
+    history_bytes,
+    violating_seeds,
+)
+from madsim_tpu.oracle.history import (
+    OP_FETCH,
+    OP_GET,
+    OP_PRODUCE,
+    OP_PUT,
+)
+
+SEEDS = jnp.arange(16, dtype=jnp.int64)
+
+ETCD_CLEAN = etcd.EtcdConfig(hist_slots=256)
+ETCD_BUG = etcd.EtcdConfig(hist_slots=256, bug_stale_read=True)
+
+
+def _ecfg(cfg):
+    return etcd.engine_config(cfg, time_limit_ns=2_000_000_000, max_steps=20_000)
+
+
+def _op(c, o, k, inp, out, t0, t1, opid=0):
+    return Op(c, o, k, inp, out, t0, t1, opid)
+
+
+def _hist(*ops):
+    return History(seed=0, ops=tuple(ops), overflow=False, rows=2 * len(ops))
+
+
+# -- the checker on hand-written histories -----------------------------------
+
+
+def test_checker_accepts_linearizable_register_history():
+    """Concurrent put/get interleavings with a consistent witness order."""
+    h = _hist(
+        _op(0, OP_PUT, 1, 5, 5, 0, 100, 0),
+        _op(1, OP_GET, 1, 0, -1, 10, 50, 0),  # read-before-put is open interval
+        _op(1, OP_GET, 1, 0, 5, 60, 150, 1),  # concurrent with put: may see it
+        _op(0, OP_GET, 1, 0, 5, 200, 250, 1),
+    )
+    r = check_history(h, KVSpec())
+    assert r.ok and r.decided and r.bad_index == -1
+
+
+def test_checker_rejects_stale_read():
+    """A read strictly after an acked overwrite must not see the old value."""
+    h = _hist(
+        _op(0, OP_PUT, 1, 5, 5, 0, 100, 0),
+        _op(0, OP_PUT, 1, 7, 7, 150, 250, 1),
+        _op(1, OP_GET, 1, 0, 5, 300, 400, 0),
+    )
+    r = check_history(h, KVSpec())
+    assert not r.ok and r.bad_index == 2
+    assert "get" in r.reason
+
+
+def test_checker_rejects_phantom_read():
+    """A read of a value nobody ever wrote has no explanation."""
+    h = _hist(_op(1, OP_GET, 3, 0, 42, 10, 20, 0))
+    r = check_history(h, KVSpec())
+    assert not r.ok and r.bad_index == 0
+
+
+def test_open_ops_are_optional():
+    """A PUT whose ack was lost may have happened (a later read observes
+    it) or not (no read does) — both histories are linearizable."""
+    observed = _hist(
+        _op(0, OP_PUT, 1, 5, 0, 0, -1, 0),
+        _op(1, OP_GET, 1, 0, 5, 300, 400, 0),
+    )
+    silent = _hist(
+        _op(0, OP_PUT, 1, 5, 0, 0, -1, 0),
+        _op(1, OP_GET, 1, 0, -1, 300, 400, 0),
+    )
+    assert check_history(observed, KVSpec()).ok
+    assert check_history(silent, KVSpec()).ok
+
+
+def test_keys_check_independently():
+    """Locality: a violation on key 2 never implicates ops on key 1, and
+    the reported bad op is the earliest-invoked one across partitions."""
+    h = _hist(
+        _op(0, OP_PUT, 1, 5, 5, 0, 100, 0),
+        _op(0, OP_PUT, 2, 6, 6, 120, 200, 1),
+        _op(1, OP_GET, 2, 0, 9, 300, 400, 0),  # phantom on key 2
+        _op(1, OP_GET, 1, 0, 5, 500, 600, 1),  # fine on key 1
+    )
+    r = check_history(h, KVSpec())
+    assert not r.ok
+    assert r.bad_op.key == 2 and r.bad_index == 2
+
+
+def test_first_bad_prefix_locates_the_op():
+    ops = (
+        _op(0, OP_PUT, 1, 5, 5, 0, 100, 0),
+        _op(1, OP_GET, 1, 0, 5, 200, 300, 0),
+        _op(1, OP_GET, 1, 0, 8, 400, 500, 1),  # first inexplicable op
+        _op(1, OP_GET, 1, 0, 5, 600, 700, 2),
+    )
+    assert first_bad_prefix(ops, KVSpec()) == 3
+    assert first_bad_prefix(ops[:2], KVSpec()) == -1
+
+
+def test_first_bad_prefix_is_partition_aware():
+    """A linearizable multi-key history must never be rejected by
+    cross-key state mixing, and a bad op's prefix length is its global
+    index + 1 even with other keys' ops interleaved before it."""
+    ok_ops = (
+        _op(0, OP_PUT, 1, 5, 5, 0, 100, 0),
+        _op(0, OP_PUT, 2, 7, 7, 150, 250, 1),
+        _op(1, OP_GET, 1, 0, 5, 300, 400, 0),
+    )
+    assert first_bad_prefix(ok_ops, KVSpec()) == -1
+    mixed = ok_ops + (_op(1, OP_GET, 2, 0, 9, 500, 600, 1),)  # phantom k2
+    assert first_bad_prefix(mixed, KVSpec()) == 4
+
+
+def test_log_spec_rejects_overread_and_broken_contiguity():
+    """LogSpec: a fetch serving records beyond every linearizable append
+    count fails the search; an offset gap fails the structural pass."""
+    overread = _hist(
+        _op(0, OP_PRODUCE, 0, 0, 0, 0, 50, 0),
+        _op(4, OP_FETCH, 0, 0, 3, 100, 200, 0),  # 3 records, 1 produce
+    )
+    r = check_history(overread, LogSpec())
+    assert not r.ok and r.bad_op.op == OP_FETCH
+    gap = _hist(
+        _op(0, OP_PRODUCE, 0, 0, 0, 0, 50, 0),
+        _op(0, OP_PRODUCE, 0, 1, 1, 60, 110, 1),
+        _op(4, OP_FETCH, 0, 0, 1, 100, 200, 0),
+        _op(4, OP_FETCH, 0, 2, 1, 300, 400, 1),  # skipped offset 1
+    )
+    r2 = check_history(gap, LogSpec())
+    assert not r2.ok and "contiguity" in r2.reason
+
+
+# -- the engine recording plane ----------------------------------------------
+
+
+def test_history_overflow_latches_and_prefix_is_untouched():
+    """Satellite contract: overfilling a tiny buffer latches the sticky
+    per-seed flag (surfaced in the chunk summary like queue overflow),
+    never wraps — the recorded prefix is row-for-row the big buffer's."""
+    tiny_cfg = ETCD_CLEAN._replace(hist_slots=8)
+    ecfg = _ecfg(ETCD_CLEAN)
+    big = ecore.run_sweep(etcd.workload(ETCD_CLEAN), ecfg, SEEDS)
+    tiny = ecore.run_sweep(etcd.workload(tiny_cfg), _ecfg(tiny_cfg), SEEDS)
+    assert (np.asarray(big.hist_len) > 8).all(), "fixture must overfill"
+    assert np.asarray(tiny.hist_overflow).all()
+    assert not np.asarray(big.hist_overflow).any()
+    assert (np.asarray(tiny.hist_len) == 8).all()
+    # untouched prefix: the first 8 rows match the unconstrained run
+    np.testing.assert_array_equal(
+        np.asarray(tiny.hist_rec), np.asarray(big.hist_rec)[:, :8, :]
+    )
+    np.testing.assert_array_equal(
+        np.asarray(tiny.hist_t), np.asarray(big.hist_t)[:, :8]
+    )
+    # the flag reaches the chunk summary (models/_common engine fields)
+    assert etcd.sweep_summary(tiny)["hist_overflow_seeds"] == len(SEEDS)
+    assert etcd.sweep_summary(big)["hist_overflow_seeds"] == 0
+
+
+def test_recording_does_not_change_schedules():
+    """The history plane is pure instrumentation: the same config with
+    recording off dispatches the identical event schedule."""
+    off_cfg = ETCD_CLEAN._replace(hist_slots=0)
+    _, t_on = ecore.run_traced(etcd.workload(ETCD_CLEAN), _ecfg(ETCD_CLEAN), 3)
+    _, t_off = ecore.run_traced(etcd.workload(off_cfg), _ecfg(off_cfg), 3)
+    for k in ("time_ns", "kind", "pay", "fired"):
+        np.testing.assert_array_equal(np.asarray(t_on[k]), np.asarray(t_off[k]))
+
+
+def test_sweep_and_traced_histories_are_byte_identical():
+    """The cross-path determinism contract: one (spec, seed) decodes to
+    identical canonical bytes from a sweep lane and from the bit-exact
+    CPU traced replay."""
+    wl, ecfg = etcd.workload(ETCD_CLEAN), _ecfg(ETCD_CLEAN)
+    final = ecore.run_sweep(wl, ecfg, SEEDS)
+    for lane in (0, 7, 11):
+        traced_final, _ = ecore.run_traced(wl, ecfg, int(SEEDS[lane]))
+        assert history_bytes(decode_seed(traced_final)) == history_bytes(
+            decode_seed(final, lane)
+        )
+
+
+# -- validation: fires on the seeded bug, clean on the default ---------------
+
+
+def test_checker_fires_on_etcd_stale_read_bug():
+    """The oracle's reason to exist: a defect no online latch can see
+    (revision/lease bookkeeping intact) is caught from the history
+    alone, over a pinned seed range."""
+    final = ecore.run_sweep(etcd.workload(ETCD_BUG), _ecfg(ETCD_BUG), SEEDS)
+    vio = violating_seeds(final, KVSpec())
+    assert vio.size >= 1, "checker never fired on bug_stale_read"
+    assert not np.asarray(final.wstate.violation).any(), (
+        "online latches saw the bug — it no longer validates the oracle"
+    )
+    # replay.py surfaces the same set
+    np.testing.assert_array_equal(
+        replay.history_violation_seeds(final, KVSpec()), vio
+    )
+
+
+def test_default_configs_check_linearizable():
+    """No false positives: etcd (KV register) and kafka (ordered log)
+    histories over pinned seed ranges all pass their specs."""
+    efinal = ecore.run_sweep(etcd.workload(ETCD_CLEAN), _ecfg(ETCD_CLEAN), SEEDS)
+    assert violating_seeds(efinal, KVSpec()).size == 0
+    kcfg = kafka.KafkaConfig(hist_slots=512)
+    kecfg = kafka.engine_config(kcfg, time_limit_ns=2_000_000_000, max_steps=20_000)
+    kfinal = ecore.run_sweep(kafka.workload(kcfg), kecfg, SEEDS)
+    assert not np.asarray(kfinal.hist_overflow).any()
+    assert violating_seeds(kfinal, LogSpec()).size == 0
+    # histories are non-trivial (ops actually completed)
+    assert all(
+        any(o.complete for o in h.ops) for h in decode_sweep(kfinal)
+    )
+
+
+# -- explore wiring: history triage flavor + checker-verified shrink ---------
+
+
+def test_history_triage_and_shrink_close_the_loop(tmp_path):
+    """End-to-end: the seeded-bug sweep yields a seed the checker
+    rejects; triage fingerprints it under the history flavor; shrink
+    emits a minimal FixedFaults triple every candidate of which was
+    re-verified through the checker; the minimal triple reproduces."""
+    from madsim_tpu.explore.targets import oracle_demo_faults
+
+    target = explore.stale_etcd_target()
+    spec = oracle_demo_faults()
+    wl, ecfg = target.build(spec)
+    final = ecore.run_sweep(wl, ecfg, jnp.arange(8, dtype=jnp.int64))
+    vio = np.asarray(target.violating(final))
+    assert vio.size >= 1
+    seed = int(vio[0])
+
+    f = explore.triage_seed(target, spec, seed, history=True)
+    assert f is not None
+    assert f.flavor == explore.HISTORY_FLAVOR
+    assert f.fingerprint == "etcd-stale:history:get"
+    # deterministic across reruns
+    assert explore.triage_seed(target, spec, seed, history=True) == f
+
+    sr = explore.shrink(target, spec, seed, max_tests=6, history=True)
+    assert sr is not None and sr.fingerprint == f.fingerprint
+    assert len(sr.schedule) <= sr.original_len
+    again = explore.triage_seed(target, sr.spec, sr.seed, history=True)
+    assert again is not None and again.fingerprint == f.fingerprint
+
+
+def test_probe_triage_requires_spec_and_recording():
+    target = explore.amnesia_raft_target()
+    with pytest.raises(ValueError, match="hist_spec"):
+        explore.triage_seed(target, FaultSpec(), 0, history=True)
+
+
+# -- viol_kind flavor parity (etcd + kafka, like raft) -----------------------
+
+
+def test_etcd_viol_kind_flavors():
+    """bug_rev_regress latches V_REV; the traced probe channel carries
+    the flavor so triage fingerprints are no longer flavor-less."""
+    cfg = etcd.EtcdConfig(bug_rev_regress=True)
+    ecfg = etcd.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    final = ecore.run_sweep(etcd.workload(cfg), ecfg, jnp.arange(48, dtype=jnp.int64))
+    vio = np.asarray(final.wstate.violation)
+    vk = np.asarray(final.wstate.viol_kind)
+    assert vio.any(), "rev-regress fixture found no violation"
+    assert (vk[vio] != 0).all() and ((vk[vio] & etcd.V_REV) != 0).any()
+    assert (vk[~vio] == 0).all()
+    seed = int(np.asarray(final.seed)[vio][0])
+    _, trace = ecore.run_traced(etcd.workload(cfg), ecfg, seed)
+    probe = np.asarray(trace["probe"])
+    fired = np.asarray(trace["fired"])
+    hits = np.nonzero(fired & (probe != 0))[0]
+    assert hits.size > 0 and probe[hits[0]] & etcd.V_REV
+
+
+def test_kafka_viol_kind_flavors():
+    cfg = kafka.KafkaConfig(bug_ack_on_append=True, crashes=2)
+    ecfg = kafka.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    final = ecore.run_sweep(kafka.workload(cfg), ecfg, jnp.arange(48, dtype=jnp.int64))
+    vio = np.asarray(final.wstate.violation)
+    vk = np.asarray(final.wstate.viol_kind)
+    assert vio.any(), "ack-loss fixture found no violation"
+    assert (vk[vio] != 0).all() and ((vk[vio] & kafka.V_ACK_LOSS) != 0).any()
+    assert (vk[~vio] == 0).all()
+
+
+# -- the host-tier recorder shim ---------------------------------------------
+
+
+def test_host_recorder_matches_device_format():
+    """The client-shim yields the same History structure the device
+    decoder produces, checkable by the same spec — including open ops
+    and the canonical byte encoding."""
+    t = [0]
+
+    def clock():
+        t[0] += 10
+        return t[0]
+
+    rec = HostRecorder(clock=clock)
+    a = rec.invoke(client=0, op=OP_PUT, key=3, inp=42)
+    rec.complete(client=0, opid=a, out=42)
+    b = rec.invoke(client=1, op=OP_GET, key=3, inp=0)
+    rec.complete(client=1, opid=b, out=42)
+    rec.invoke(client=1, op=OP_GET, key=4, inp=0)  # never completes
+    h = rec.history(seed=9)
+    assert [o.complete for o in h.ops] == [True, True, False]
+    assert check_history(h, KVSpec()).ok
+    assert history_bytes(h) == history_bytes(rec.history(seed=9))
+    # shim-usage bugs raise at the offending call, not from the decoder:
+    # unknown id, and double-completion of an already-closed op
+    with pytest.raises(ValueError):
+        rec.complete(client=2, opid=0, out=1)
+    with pytest.raises(ValueError):
+        rec.complete(client=0, opid=a, out=42)
