@@ -13,6 +13,11 @@
 #   make oracle-smoke  the history-oracle pipeline end to end (seeded
 #                      etcd bug -> linearizability checker -> triage ->
 #                      shrink -> cross-path history byte identity)
+#   make differential-smoke
+#                      host<->device differential gate: matched
+#                      (spec, seed) grids incl. every gray-failure
+#                      family, outcome distributions within tolerances,
+#                      both tiers' histories checked by one spec
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -31,7 +36,8 @@ PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
-	explore-smoke oracle-smoke dryrun bench-smoke test-all
+	explore-smoke oracle-smoke differential-smoke dryrun bench-smoke \
+	test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -52,7 +58,15 @@ explore-smoke:
 oracle-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/oracle_demo.py
 
-stest: test determinism explore-smoke oracle-smoke
+# host<->device differential gate (docs/faults.md "Gray failures"): a
+# 200-seed matched-(spec, seed) grid per fault family — crash storm +
+# asymmetric partitions + fsync-stall/power-fail + clock skew — outcome
+# distributions within tolerances, election histories checked against
+# one sequential spec on both tiers
+differential-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/differential_demo.py
+
+stest: test determinism explore-smoke oracle-smoke differential-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

@@ -24,6 +24,8 @@ sys.path.insert(0, __file__.rsplit("/", 2)[0])
 import madsim_tpu as ms
 from madsim_tpu import faults
 from madsim_tpu.net import Endpoint
+from madsim_tpu.oracle import HostRecorder
+from madsim_tpu.oracle.history import OP_ELECT
 
 FOLLOWER, CANDIDATE, LEADER = 0, 1, 2
 TAG = 1
@@ -50,10 +52,18 @@ class _Node:
         self.term = 0
         self.voted = -1
         self.votes: set = set()
-        self.deadline = ms.time.now_instant() + ms.rand.uniform(ELECTION_LO, ELECTION_HI)
+        self.deadline = ms.time.now_instant() + self._timeout()
+
+    def _timeout(self) -> float:
+        """Election timeout as THIS node's (possibly skewed) clock
+        measures it: inside a clock-skew window the node's timers
+        stretch by num/den — the host half of the device tier's
+        ``engine.faults.skewed_delay`` (docs/faults.md gray failures)."""
+        num, den = ms.time.node_skew()
+        return ms.rand.uniform(ELECTION_LO, ELECTION_HI) * num / den
 
     def _reset_deadline(self) -> None:
-        self.deadline = ms.time.now_instant() + ms.rand.uniform(ELECTION_LO, ELECTION_HI)
+        self.deadline = ms.time.now_instant() + self._timeout()
 
     async def _broadcast(self, ep: Endpoint, msg: tuple) -> None:
         for j in range(self.n):
@@ -64,6 +74,13 @@ class _Node:
     async def _become_leader(self, ep: Endpoint) -> None:
         self.role = LEADER
         self.stats["elections"].append((self.term, self.i))
+        rec = self.stats.get("recorder")
+        if rec is not None:
+            # same row the device model's record hook writes: one
+            # OP_ELECT invoke per won election (client = node, key =
+            # term) — checkable by oracle.specs.ElectionSpec on either
+            # tier (explore/differential.py)
+            rec.invoke(client=self.i, op=OP_ELECT, key=self.term, inp=self.i)
         for term, who in self.stats["elections"]:
             if term == self.term and who != self.i:
                 self.stats["violations"] += 1
@@ -145,16 +162,34 @@ async def _supervise(stats: Dict, n: int, crashes: int, sim_seconds: float) -> N
         await ms.sleep(remaining)
 
 
+def _fresh_stats() -> Dict:
+    """Run stats + the op-history recorder (oracle.HostRecorder): every
+    run emits a checkable election history alongside the counters, so
+    the differential harness (explore/differential.py) can check host
+    and device histories against the same sequential spec."""
+    return {
+        "elections": [],
+        "violations": 0,
+        "msgs": 0,
+        "recorder": HostRecorder(),
+    }
+
+
+def _finish_stats(stats: Dict, seed: int) -> Dict:
+    stats["seed"] = seed
+    stats["leaders_elected"] = len(stats["elections"])
+    stats["history"] = stats.pop("recorder").history(seed)
+    return stats
+
+
 def run_seed(
     seed: int, n: int = 5, crashes: int = 1, sim_seconds: float = 3.0
 ) -> Dict:
     """One complete simulation; returns election stats for the seed."""
-    stats: Dict = {"elections": [], "violations": 0, "msgs": 0}
+    stats = _fresh_stats()
     rt = ms.Runtime(seed=seed)
     rt.block_on(_supervise(stats, n, crashes, sim_seconds))
-    stats["seed"] = seed
-    stats["leaders_elected"] = len(stats["elections"])
-    return stats
+    return _finish_stats(stats, seed)
 
 
 async def _supervise_plan(
@@ -177,38 +212,46 @@ async def _supervise_plan(
 
 
 def run_seed_with_plan(
-    seed: int, plan, n: int = 5, sim_seconds: float = 3.0, spec=None
+    seed: int, plan, n: int = 5, sim_seconds: float = 3.0, spec=None,
+    extend: bool = True,
 ) -> Dict:
     """One simulation with the recorded faults at the recorded virtual
     times.
 
     The cross-tier replay target: a device-found seed's fault schedule
     re-applied to this ordinary async implementation, debugger-attachable.
-    The run always extends at least one second past the last planned
+    By default the run extends at least one second past the last planned
     fault so the cluster gets a post-fault observation window even when
-    the plan outlives ``sim_seconds``. ``spec`` is only needed when the
-    schedule contains latency/loss burst events.
+    the plan outlives ``sim_seconds``; pass ``extend=False`` to hard-stop
+    at ``sim_seconds`` instead (the differential harness does — a matched
+    host↔device grid needs matched horizons, and the device tier stops
+    at its ``time_limit_ns`` regardless of the schedule). ``spec`` is
+    only needed when the schedule contains latency/loss burst or
+    clock-skew events.
     """
-    stats: Dict = {"elections": [], "violations": 0, "msgs": 0}
+    stats = _fresh_stats()
     end_s = sim_seconds
-    if plan:
+    if plan and extend:
         end_s = max(end_s, max(t for t, _, _ in plan) / 1e9 + 1.0)
+    elif plan and not extend:
+        plan = [e for e in plan if e[0] / 1e9 < sim_seconds]
     rt = ms.Runtime(seed=seed)
     rt.block_on(_supervise_plan(stats, n, plan, end_s, spec=spec))
-    stats["seed"] = seed
-    stats["leaders_elected"] = len(stats["elections"])
-    return stats
+    return _finish_stats(stats, seed)
 
 
 def run_seed_with_spec(
-    seed: int, spec, campaign_seed: int, n: int = 5, sim_seconds: float = 3.0
+    seed: int, spec, campaign_seed: int, n: int = 5, sim_seconds: float = 3.0,
+    extend: bool = True,
 ) -> Dict:
     """One simulation under a declarative fault campaign: the SAME
     ``FaultSpec`` + ``campaign_seed`` a device-tier sweep lane compiles
     (models/raft.py ``fault_spec``), applied to this ordinary async
     implementation — no trace hop needed."""
     plan = faults.compile_host(spec, n, campaign_seed)
-    return run_seed_with_plan(seed, plan, n=n, sim_seconds=sim_seconds, spec=spec)
+    return run_seed_with_plan(
+        seed, plan, n=n, sim_seconds=sim_seconds, spec=spec, extend=extend
+    )
 
 
 if __name__ == "__main__":

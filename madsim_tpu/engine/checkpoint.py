@@ -25,7 +25,11 @@ from .core import EngineConfig, EngineState, Workload
 # v5: EngineState gained the operation-history plane (``hist_rec``,
 #     ``hist_t``, ``hist_len``, ``hist_overflow`` — madsim_tpu/oracle),
 #     so v4 files would load positionally misaligned.
-_FORMAT_VERSION = 5
+# v6: gray-failure grammar — ``FaultState`` split ``part_cnt`` into
+#     per-direction refcounts and gained ``fsync_cnt``/``skew_cnt``, and
+#     the raft model grew its durability shadows, so v5 files would load
+#     positionally misaligned.
+_FORMAT_VERSION = 6
 
 
 def save_sweep(state: EngineState, path: str) -> None:

@@ -10,7 +10,10 @@ declarative spec:
 - ``FaultSpec`` is a pure NamedTuple (hashable — it rides inside model
   configs, which are jit cache keys): crash/restart storms, partition/heal
   cycles over a node group, network-wide latency-spike and message-loss
-  bursts, and node pause/resume windows.
+  bursts, node pause/resume windows — plus the GRAY-failure families
+  (docs/faults.md): asymmetric one-directional partitions, slow-disk
+  fsync-stall windows, power-fail windows that drop unsynced writes, and
+  per-node clock-skew windows.
 - ``schedule_events(spec, num_nodes, key)`` is THE schedule derivation —
   seeded draws of fire times, durations and victims in a dedicated RNG
   namespace (disjoint from every model's init/event streams). The device
@@ -59,6 +62,17 @@ F_LOSS_ON = 6
 F_LOSS_OFF = 7
 F_PAUSE = 8
 F_RESUME = 9
+# gray-failure actions (one-directional partitions, slow disks, power
+# loss, clock skew) — appended so existing codes/wire names stay stable
+F_PART_IN = 10  # clog only the victim's INBOUND links
+F_HEAL_IN = 11
+F_PART_OUT = 12  # clog only the victim's OUTBOUND links
+F_HEAL_OUT = 13
+F_FSYNC_STALL = 14  # the victim's disk stops making writes durable
+F_FSYNC_OK = 15  # ... and catches up (pending syncs apply)
+F_POWER_FAIL = 16  # node loses power: dies AND unsynced writes drop
+F_SKEW_ON = 17  # the victim's clock drifts: timers stretch
+F_SKEW_OFF = 18
 
 #: action code -> stable wire name (used by the host supervisor + replay)
 ACTION_NAMES = (
@@ -72,6 +86,15 @@ ACTION_NAMES = (
     "loss_off",
     "pause",
     "resume",
+    "part_in",
+    "heal_in",
+    "part_out",
+    "heal_out",
+    "fsync_stall",
+    "fsync_ok",
+    "power_fail",
+    "skew_on",
+    "skew_off",
 )
 
 #: stable wire name -> action code (the inverse, for literal schedules)
@@ -127,6 +150,42 @@ class FaultSpec(NamedTuple):
     pause_lo_ns: int = 100_000_000
     pause_hi_ns: int = 1_000_000_000
     pause_group: Group = (0, -1)
+    # -- gray failures (appended: old specs keep their field positions) --
+    # asymmetric partitions: clog ONE direction of the victim's links; the
+    # direction (in vs out) is part of the victim draw, so half the
+    # windows are inbound-only and half outbound-only
+    aparts: int = 0
+    apart_window_ns: int = 3_000_000_000
+    apart_lo_ns: int = 500_000_000
+    apart_hi_ns: int = 2_000_000_000
+    apart_group: Group = (0, -1)
+    # slow-disk windows: while open, the victim's fsync defers — writes
+    # stay volatile; the window's end applies pending syncs (host tier:
+    # ``FsSim.stall_fsync``/``unstall_fsync``)
+    fsync_stalls: int = 0
+    fsync_window_ns: int = 3_000_000_000
+    fsync_lo_ns: int = 500_000_000
+    fsync_hi_ns: int = 2_000_000_000
+    fsync_group: Group = (0, -1)
+    # power-fail windows: the victim dies losing every unsynced write
+    # (host tier: ``fs.power_fail`` + ``Handle.kill``) and restarts after
+    # the drawn down-time
+    power_fails: int = 0
+    power_window_ns: int = 5_000_000_000
+    power_lo_ns: int = 100_000_000
+    power_hi_ns: int = 1_000_000_000
+    power_group: Group = (0, -1)
+    # clock-skew windows: the victim's virtual clock drifts slow — every
+    # timer it arms stretches by skew_num/skew_den (device: models route
+    # timer deadlines through ``skewed_delay``; host: ``time.sleep`` and
+    # ``TimeHandle.node_skew`` consumers)
+    skews: int = 0
+    skew_window_ns: int = 3_000_000_000
+    skew_lo_ns: int = 500_000_000
+    skew_hi_ns: int = 2_000_000_000
+    skew_group: Group = (0, -1)
+    skew_num: int = 3
+    skew_den: int = 2
 
 
 class FixedFaults(NamedTuple):
@@ -148,6 +207,8 @@ class FixedFaults(NamedTuple):
     spike_lat_lo_ns: int = 1_000_000_000
     spike_lat_hi_ns: int = 5_000_000_000
     burst_loss_q32: int = prob_to_q32(0.5)
+    skew_num: int = 3
+    skew_den: int = 2
 
 
 def num_events(spec) -> int:
@@ -157,7 +218,15 @@ def num_events(spec) -> int:
     if isinstance(spec, FixedFaults):
         return len(spec.events)
     return 2 * (
-        spec.crashes + spec.partitions + spec.spikes + spec.losses + spec.pauses
+        spec.crashes
+        + spec.partitions
+        + spec.spikes
+        + spec.losses
+        + spec.pauses
+        + spec.aparts
+        + spec.fsync_stalls
+        + spec.power_fails
+        + spec.skews
     )
 
 
@@ -175,7 +244,9 @@ def _resolve_group(group: Group, num_nodes: int, what: str) -> Tuple[int, int]:
 
 def _categories(spec: FaultSpec, num_nodes: int):
     """(count, on_action, off_action, window, dur_lo, dur_hi, vic_lo,
-    vic_hi) per category, in the fixed draw order."""
+    vic_hi) per category, in the fixed draw order. The asymmetric
+    category's actions are ``(in, out)`` PAIRS — the direction rides in
+    the victim draw's low bit (see ``schedule_events``)."""
     return (
         (
             spec.crashes, F_CRASH, F_RESTART, spec.crash_window_ns,
@@ -199,6 +270,26 @@ def _categories(spec: FaultSpec, num_nodes: int):
             spec.pauses, F_PAUSE, F_RESUME, spec.pause_window_ns,
             spec.pause_lo_ns, spec.pause_hi_ns,
             *_resolve_group(spec.pause_group, num_nodes, "pause"),
+        ),
+        (
+            spec.aparts, (F_PART_IN, F_PART_OUT), (F_HEAL_IN, F_HEAL_OUT),
+            spec.apart_window_ns, spec.apart_lo_ns, spec.apart_hi_ns,
+            *_resolve_group(spec.apart_group, num_nodes, "apart"),
+        ),
+        (
+            spec.fsync_stalls, F_FSYNC_STALL, F_FSYNC_OK,
+            spec.fsync_window_ns, spec.fsync_lo_ns, spec.fsync_hi_ns,
+            *_resolve_group(spec.fsync_group, num_nodes, "fsync"),
+        ),
+        (
+            spec.power_fails, F_POWER_FAIL, F_RESTART,
+            spec.power_window_ns, spec.power_lo_ns, spec.power_hi_ns,
+            *_resolve_group(spec.power_group, num_nodes, "power"),
+        ),
+        (
+            spec.skews, F_SKEW_ON, F_SKEW_OFF, spec.skew_window_ns,
+            spec.skew_lo_ns, spec.skew_hi_ns,
+            *_resolve_group(spec.skew_group, num_nodes, "skew"),
         ),
     )
 
@@ -251,9 +342,21 @@ def schedule_events(spec, num_nodes: int, key: jax.Array):
         for _ in range(count):
             t0 = bounded(rand[3 * i], 0, window)
             dur = bounded(rand[3 * i + 1], dlo, dhi)
-            vic = bounded(rand[3 * i + 2], vlo, vhi).astype(jnp.int32)
+            if isinstance(a_on, tuple):
+                # directional category: the victim draw covers twice the
+                # node range; the low bit picks in vs out, so the draw
+                # budget stays at the fixed 3 per window pair
+                d = bounded(rand[3 * i + 2], 0, 2 * (vhi - vlo))
+                vic = (vlo + (d >> 1)).astype(jnp.int32)
+                out = (d & 1) == 1
+                on = jnp.where(out, a_on[1], a_on[0]).astype(jnp.int32)
+                off = jnp.where(out, a_off[1], a_off[0]).astype(jnp.int32)
+            else:
+                vic = bounded(rand[3 * i + 2], vlo, vhi).astype(jnp.int32)
+                on = jnp.asarray(a_on, jnp.int32)
+                off = jnp.asarray(a_off, jnp.int32)
             times += [t0, t0 + dur]
-            actions += [jnp.asarray(a_on, jnp.int32), jnp.asarray(a_off, jnp.int32)]
+            actions += [on, off]
             victims += [vic, vic]
             i += 1
     return jnp.stack(times), jnp.stack(actions), jnp.stack(victims)
@@ -307,11 +410,20 @@ class NetBase(NamedTuple):
 
 class FaultState(NamedTuple):
     """Per-seed interpreter state for the compiled campaign — the shared
-    piece of every model's workload state."""
+    piece of every model's workload state.
+
+    Partition refcounts are PER DIRECTION: a symmetric ``partition``
+    holds both of its victim's directions, an asymmetric ``part_in`` /
+    ``part_out`` holds exactly one — so a symmetric heal can never
+    un-clog a direction an overlapping asymmetric window still holds
+    (and vice versa). A direction is clogged iff its count is > 0."""
 
     alive: jnp.ndarray  # bool[N]
     paused: jnp.ndarray  # bool[N]
-    part_cnt: jnp.ndarray  # int32[N] per-victim partition refcount
+    part_in_cnt: jnp.ndarray  # int32[N] inbound-clog refcount
+    part_out_cnt: jnp.ndarray  # int32[N] outbound-clog refcount
+    fsync_cnt: jnp.ndarray  # int32[N] slow-disk (fsync-stall) refcount
+    skew_cnt: jnp.ndarray  # int32[N] clock-skew refcount
     spike_cnt: jnp.ndarray  # int32 latency-burst refcount
     loss_cnt: jnp.ndarray  # int32 loss-burst refcount
 
@@ -325,7 +437,9 @@ class FaultEdges(NamedTuple):
     wipes, timer-chain re-arms) off these booleans instead of re-deriving
     them, so the host-mirror semantics stay single-sourced."""
 
-    crashed: jnp.ndarray  # bool: a live victim died
+    crashed: jnp.ndarray  # bool: a live victim died (crash OR power_fail;
+    # both roll durable state back to the synced frontier — models with a
+    # durability plane key the rollback off this edge)
     restarted: jnp.ndarray  # bool: a dead victim revived
     paused: jnp.ndarray  # bool: a live, running victim paused
     resumed: jnp.ndarray  # bool: a live, paused victim resumed
@@ -335,7 +449,10 @@ def init_state(num_nodes: int) -> FaultState:
     return FaultState(
         alive=jnp.ones((num_nodes,), bool),
         paused=jnp.zeros((num_nodes,), bool),
-        part_cnt=jnp.zeros((num_nodes,), jnp.int32),
+        part_in_cnt=jnp.zeros((num_nodes,), jnp.int32),
+        part_out_cnt=jnp.zeros((num_nodes,), jnp.int32),
+        fsync_cnt=jnp.zeros((num_nodes,), jnp.int32),
+        skew_cnt=jnp.zeros((num_nodes,), jnp.int32),
         spike_cnt=jnp.zeros((), jnp.int32),
         loss_cnt=jnp.zeros((), jnp.int32),
     )
@@ -344,6 +461,37 @@ def init_state(num_nodes: int) -> FaultState:
 def up(f: FaultState) -> jnp.ndarray:
     """bool[N]: node is processing events (alive and not paused)."""
     return f.alive & ~f.paused
+
+
+def stalled(f: FaultState) -> jnp.ndarray:
+    """bool[N]: node's disk is inside a slow-disk window (fsync defers).
+    Models gate their durability plane on this: while True, the synced
+    shadow of durable state freezes; the window's end catches it up."""
+    return f.fsync_cnt > 0
+
+
+def can_skew(spec) -> bool:
+    """Whether the (static, trace-time) spec can ever open a skew
+    window. Gates ``skewed_delay`` off entirely for skew-free specs."""
+    if isinstance(spec, FixedFaults):
+        return any(a in ("skew_on", "skew_off") for _, a, _ in spec.events)
+    return spec.skews > 0
+
+
+def skewed_delay(spec, f: FaultState, node, delay_ns):
+    """A timer interval as the (possibly skewed) victim's clock measures
+    it: while ``node`` is inside a clock-skew window its timers stretch
+    by ``spec.skew_num / spec.skew_den`` (both ``FaultSpec`` and
+    ``FixedFaults`` carry the ratio). Models route every node-owned
+    timer re-arm through this — the device half of the host tier's
+    ``time.node_skew`` (docs/faults.md gray failures). Statically an
+    identity when the spec draws no skew windows (``skew_cnt`` is
+    provably zero then), so the common case pays nothing."""
+    d = jnp.asarray(delay_ns, jnp.int64)
+    if not can_skew(spec):
+        return d
+    slow = get1(f.skew_cnt, node) > 0
+    return jnp.where(slow, d * spec.skew_num // spec.skew_den, d)
 
 
 def on_event(
@@ -363,7 +511,7 @@ def on_event(
     compose exactly: only the 0→1 edge applies and only the 1→0 edge
     restores (same discipline the etcd model used for its private
     partition plan)."""
-    is_crash = action == F_CRASH
+    is_crash = (action == F_CRASH) | (action == F_POWER_FAIL)
     is_restart = action == F_RESTART
     is_part = action == F_PART
     is_heal = action == F_HEAL
@@ -391,18 +539,43 @@ def on_event(
     paused = set1(paused, victim, True, is_pause & was_alive)
     paused = set1(paused, victim, False, is_resume & was_alive)
 
-    # partitions: refcounted node clog (ref NetSim::clog_node)
-    cnt = get1(f.part_cnt, victim)
-    clogged = enet.clog_node(links, victim)
-    links = jax.tree.map(
-        lambda a, b: jnp.where(is_part & (cnt == 0), a, b), clogged, links
+    # partitions, per direction (ref NetSim::clog_node_in/out): a
+    # symmetric partition holds BOTH of the victim's directions, an
+    # asymmetric window exactly one. The clog matrix is DERIVED from the
+    # refcounts — clog[s, d] iff s's outbound or d's inbound count is
+    # held — so overlapping symmetric/asymmetric windows of the same OR
+    # different victims compose exactly (a heal can never un-clog a cell
+    # any other live window still holds; the old incremental clog_node
+    # masks could, for two victims sharing a link cell)
+    inc_in = is_part | (action == F_PART_IN)
+    dec_in = is_heal | (action == F_HEAL_IN)
+    inc_out = is_part | (action == F_PART_OUT)
+    dec_out = is_heal | (action == F_HEAL_OUT)
+    in_cnt = get1(f.part_in_cnt, victim)
+    out_cnt = get1(f.part_out_cnt, victim)
+    part_in_cnt = set1(f.part_in_cnt, victim, in_cnt + 1, inc_in)
+    part_in_cnt = set1(part_in_cnt, victim, jnp.maximum(in_cnt - 1, 0), dec_in)
+    part_out_cnt = set1(f.part_out_cnt, victim, out_cnt + 1, inc_out)
+    part_out_cnt = set1(
+        part_out_cnt, victim, jnp.maximum(out_cnt - 1, 0), dec_out
     )
-    unclogged = enet.unclog_node(links, victim)
-    links = jax.tree.map(
-        lambda a, b: jnp.where(is_heal & (cnt == 1), a, b), unclogged, links
+    touched = inc_in | dec_in | inc_out | dec_out
+    derived = (part_out_cnt > 0)[:, None] | (part_in_cnt > 0)[None, :]
+    links = links._replace(clog=jnp.where(touched, derived, links.clog))
+
+    # slow-disk and clock-skew windows: plain per-victim refcounts; the
+    # consequences live in the models (durability shadows gated on
+    # ``stalled``, timer arming through ``skewed_delay``)
+    fs_cnt = get1(f.fsync_cnt, victim)
+    fsync_cnt = set1(f.fsync_cnt, victim, fs_cnt + 1, action == F_FSYNC_STALL)
+    fsync_cnt = set1(
+        fsync_cnt, victim, jnp.maximum(fs_cnt - 1, 0), action == F_FSYNC_OK
     )
-    part_cnt = set1(f.part_cnt, victim, cnt + 1, is_part)
-    part_cnt = set1(part_cnt, victim, jnp.maximum(cnt - 1, 0), is_heal)
+    sk_cnt = get1(f.skew_cnt, victim)
+    skew_cnt = set1(f.skew_cnt, victim, sk_cnt + 1, action == F_SKEW_ON)
+    skew_cnt = set1(
+        skew_cnt, victim, jnp.maximum(sk_cnt - 1, 0), action == F_SKEW_OFF
+    )
 
     # latency-spike bursts: override the whole link latency range
     spike_apply = is_spike_on & (f.spike_cnt == 0)
@@ -441,7 +614,10 @@ def on_event(
     f2 = FaultState(
         alive=alive,
         paused=paused,
-        part_cnt=part_cnt,
+        part_in_cnt=part_in_cnt,
+        part_out_cnt=part_out_cnt,
+        fsync_cnt=fsync_cnt,
+        skew_cnt=skew_cnt,
         spike_cnt=spike_cnt,
         loss_cnt=loss_cnt,
     )

@@ -21,6 +21,12 @@ Testing*):
   next exploration round.
 - ``targets`` — the model adapters a campaign explores (the canonical
   one: the amnesia Raft config, ``replay.amnesia_raft_config``).
+- ``differential`` — host↔device differential validation: run the
+  device raft model and ``examples/raft_host.py`` over matched
+  ``(spec, seed)`` grids (one compiled fault schedule drives both
+  tiers), compare outcome distributions within tolerances, and check
+  both tiers' recorded election histories against one sequential spec
+  (``oracle.specs.ElectionSpec``).
 
 See ``docs/explore.md`` for the full pipeline and guarantees;
 ``scripts/explore_demo.py`` runs it end to end on the CPU backend.
@@ -33,6 +39,14 @@ from .campaign import (  # noqa: F401
     run_campaign,
     spec_from_dict,
     spec_to_dict,
+)
+from .differential import (  # noqa: F401
+    DifferentialConfig,
+    TierOutcome,
+    device_outcomes,
+    gate_specs,
+    host_outcomes,
+    run_differential,
 )
 from .shrink import ShrinkResult, narrow_windows, shrink  # noqa: F401
 from .targets import Target, amnesia_raft_target, stale_etcd_target  # noqa: F401

@@ -44,13 +44,24 @@ from .targets import Target
 _MIN_NS = 10_000_000  # 10 ms
 _MAX_NS = 8_000_000_000  # 8 s
 _MAX_PHASES = 6  # per category
-_COUNT_FIELDS = ("crashes", "partitions", "spikes", "losses", "pauses")
+# the gray-failure families (aparts/fsync_stalls/power_fails/skews,
+# engine/faults.py) are first-class mutation targets: coverage-guided
+# search explores one-directional partitions, crash-without-sync and
+# clock drift the same way it explores clean crashes
+_COUNT_FIELDS = (
+    "crashes", "partitions", "spikes", "losses", "pauses",
+    "aparts", "fsync_stalls", "power_fails", "skews",
+)
 _WINDOW_FIELDS = (
     "crash_window_ns",
     "part_window_ns",
     "spike_window_ns",
     "loss_window_ns",
     "pause_window_ns",
+    "apart_window_ns",
+    "fsync_window_ns",
+    "power_window_ns",
+    "skew_window_ns",
 )
 _DUR_FIELDS = (
     ("restart_lo_ns", "restart_hi_ns"),
@@ -58,6 +69,10 @@ _DUR_FIELDS = (
     ("spike_dur_lo_ns", "spike_dur_hi_ns"),
     ("loss_dur_lo_ns", "loss_dur_hi_ns"),
     ("pause_lo_ns", "pause_hi_ns"),
+    ("apart_lo_ns", "apart_hi_ns"),
+    ("fsync_lo_ns", "fsync_hi_ns"),
+    ("power_lo_ns", "power_hi_ns"),
+    ("skew_lo_ns", "skew_hi_ns"),
 )
 # scale factors as exact integer ratios (float scaling would make the
 # mutated spec depend on platform rounding)
@@ -152,6 +167,8 @@ def spec_to_dict(spec) -> dict:
             "spike_lat_lo_ns": spec.spike_lat_lo_ns,
             "spike_lat_hi_ns": spec.spike_lat_hi_ns,
             "burst_loss_q32": spec.burst_loss_q32,
+            "skew_num": spec.skew_num,
+            "skew_den": spec.skew_den,
         }
     d = {"type": "FaultSpec"}
     for f, v in zip(spec._fields, spec):
@@ -164,11 +181,15 @@ def spec_from_dict(d: dict):
     d = dict(d)
     kind = d.pop("type")
     if kind == "FixedFaults":
+        defaults = FixedFaults()
         return FixedFaults(
             events=tuple((int(t), str(a), int(v)) for t, a, v in d["events"]),
             spike_lat_lo_ns=int(d["spike_lat_lo_ns"]),
             spike_lat_hi_ns=int(d["spike_lat_hi_ns"]),
             burst_loss_q32=int(d["burst_loss_q32"]),
+            # .get: report lines written before the gray grammar lack them
+            skew_num=int(d.get("skew_num", defaults.skew_num)),
+            skew_den=int(d.get("skew_den", defaults.skew_den)),
         )
     if kind != "FaultSpec":
         raise ValueError(f"unknown spec encoding {kind!r}")

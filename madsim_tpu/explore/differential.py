@@ -1,0 +1,311 @@
+"""Host↔device differential validation: the "does the TPU sweep find
+what host DST finds?" loop, closed (ROADMAP item 5 / VERDICT "Next
+round" #3).
+
+The repo holds two independent implementations of the same workload —
+the device Raft model (``models/raft.py``, amnesia mode) and the host
+executor's Raft example (``examples/raft_host.py``, ordinary async code
+whose in-memory state IS amnesia) — and, since the FaultSpec compiler,
+one declarative fault campaign drives both. Jepsen's differential idiom
+then applies directly: run BOTH implementations over a matched
+``(spec, seed)`` grid — the same compiled fault schedule per seed — and
+require
+
+1. **matched outcome distributions**: the per-seed election/no-leader/
+   violation rates of the two tiers agree within documented tolerances
+   (two engines cannot share an RNG stream, so individual seeds differ;
+   the distributions must not);
+2. **one sequential spec for both histories**: each tier records its
+   elections as an op history (device: the ``record`` hook; host:
+   ``oracle.HostRecorder``) and BOTH are checked against
+   ``oracle.specs.ElectionSpec`` — per seed, per tier, the checker's
+   verdict must agree exactly with that tier's own online violation
+   latch (the checker cross-validates the latches, and vice versa);
+3. **byte-deterministic reports**: the JSON report carries only integer
+   counts and sorted keys — two processes running one grid must emit
+   identical bytes (``scripts/check_determinism.sh`` gates this).
+
+Tolerances are in per-mille of the seed count. Defaults are sized from
+measured tier gaps (docs/faults.md worked example): election presence
+and no-leader rates track within a few percent; violation rates differ
+more (the host example polls its election deadline at 10 ms granularity
+and has no log, so its double-vote window differs) and get a wider
+band. ``scripts/differential_demo.py`` runs the gate grid — ≥200 seeds,
+at least one spec per gray-failure family — as ``make
+differential-smoke``.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import List, NamedTuple, Optional, Sequence
+
+import numpy as np
+
+from ..engine import core as ecore
+from ..engine.faults import FaultSpec
+from ..oracle import ElectionSpec, check_history, decode_sweep
+from .campaign import spec_to_dict
+
+
+class DifferentialConfig(NamedTuple):
+    """Grid parameters (hashable, reprs stably)."""
+
+    num_nodes: int = 3
+    seeds: int = 200
+    seed0: int = 0
+    sim_seconds: float = 2.0
+    chunk_size: int = 16384
+    # device raft sizing: the election-safety ring and the history
+    # buffer must cover every election of a seed, else the online latch
+    # and the checker see different data (overflows are surfaced in the
+    # report and fail the gate)
+    history_ring: int = 64
+    hist_slots: int = 128
+    # per-mille tolerances on |device - host| outcome rates
+    tol_elected_pm: int = 100
+    tol_no_leader_pm: int = 100
+    tol_violation_pm: int = 300
+
+
+class TierOutcome(NamedTuple):
+    """One tier's per-seed outcomes over the grid (integer counts)."""
+
+    elected_seeds: int  # seeds with >= 1 election
+    no_leader_seeds: int
+    violation_seeds: int  # that tier's own online latch
+    elections_total: int
+    commits_total: int  # device only (the host example is election-only)
+    hist_reject_seeds: int  # seeds whose history fails ElectionSpec
+    hist_mismatch_seeds: int  # checker verdict != online latch
+    hist_overflow_seeds: int
+    # device only: lanes whose event queue overflowed — a truncated lane
+    # under-counts outcomes, so any overflow fails the gate
+    overflow_seeds: int = 0
+
+
+def _pm(count: int, total: int) -> int:
+    """Integer per-mille — float-free so reports are byte-stable."""
+    return 1000 * count // total
+
+
+def device_outcomes(
+    spec, dcfg: DifferentialConfig = DifferentialConfig()
+) -> TierOutcome:
+    """Sweep the device raft model (amnesia mode — matching the host
+    example's in-memory state) over the grid and fold per-seed outcomes,
+    checking every decoded election history against ElectionSpec."""
+    from ..models import raft
+
+    cfg = raft.RaftConfig(
+        num_nodes=dcfg.num_nodes,
+        commands=0,
+        volatile_state=True,
+        history=dcfg.history_ring,
+        hist_slots=dcfg.hist_slots,
+        faults=spec,
+    )
+    ecfg = raft.engine_config(
+        cfg,
+        time_limit_ns=int(dcfg.sim_seconds * 1e9),
+        max_steps=60_000,
+    )
+    seeds = np.arange(dcfg.seed0, dcfg.seed0 + dcfg.seeds, dtype=np.int64)
+    final = ecore.run_sweep_chunked(
+        raft.workload(cfg), ecfg, seeds, chunk_size=dcfg.chunk_size
+    )
+    elections = np.asarray(final.wstate.elections)
+    commits = np.asarray(final.wstate.commits)
+    violation = np.asarray(final.wstate.violation)
+    # clipped-coverage lanes: the oracle buffer latched hist_overflow OR
+    # the online latch's election ring wrapped (it has no latch of its
+    # own — more elections than ring slots means the latch may have
+    # missed a duplicate term, which would otherwise surface only as a
+    # confusing latch/checker mismatch)
+    overflow = np.asarray(final.hist_overflow) | (
+        elections > dcfg.history_ring
+    )
+    espec = ElectionSpec()
+    rejects = 0
+    mismatches = 0
+    for lane, hist in enumerate(decode_sweep(final)):
+        bad = not check_history(hist, espec).ok
+        rejects += bad
+        mismatches += bad != bool(violation[lane])
+    return TierOutcome(
+        elected_seeds=int((elections > 0).sum()),
+        no_leader_seeds=int((elections == 0).sum()),
+        violation_seeds=int(violation.sum()),
+        elections_total=int(elections.sum()),
+        commits_total=int(commits.sum()),
+        hist_reject_seeds=rejects,
+        hist_mismatch_seeds=mismatches,
+        hist_overflow_seeds=int(overflow.sum()),
+        overflow_seeds=int(np.asarray(final.overflow).sum()),
+    )
+
+
+def host_outcomes(
+    spec, dcfg: DifferentialConfig = DifferentialConfig()
+) -> TierOutcome:
+    """Run the host-tier raft example once per grid seed under the SAME
+    compiled fault schedule (``campaign_seed = seed``, so the fault
+    environment matches the device lane of that seed by construction)
+    and fold the same outcomes, checking each recorded history.
+
+    ``extend=False``: a matched grid needs matched horizons — the host
+    run hard-stops at ``sim_seconds`` exactly like the device lane stops
+    at ``time_limit_ns``, instead of extending past a schedule that
+    outlives the window (the replay pipeline's default)."""
+    import sys
+
+    examples = __file__.rsplit("/", 3)[0] + "/examples"
+    if examples not in sys.path:
+        sys.path.insert(0, examples)
+    import raft_host
+
+    espec = ElectionSpec()
+    elected = no_leader = violating = total = 0
+    rejects = mismatches = 0
+    for seed in range(dcfg.seed0, dcfg.seed0 + dcfg.seeds):
+        out = raft_host.run_seed_with_spec(
+            seed, spec, seed, n=dcfg.num_nodes, sim_seconds=dcfg.sim_seconds,
+            extend=False,
+        )
+        n_elec = out["leaders_elected"]
+        total += n_elec
+        elected += n_elec > 0
+        no_leader += n_elec == 0
+        vio = out["violations"] > 0
+        violating += vio
+        bad = not check_history(out["history"], espec).ok
+        rejects += bad
+        mismatches += bad != vio
+    return TierOutcome(
+        elected_seeds=elected,
+        no_leader_seeds=no_leader,
+        violation_seeds=violating,
+        elections_total=total,
+        commits_total=0,
+        hist_reject_seeds=rejects,
+        hist_mismatch_seeds=mismatches,
+        hist_overflow_seeds=0,
+    )
+
+
+def compare(
+    dev: TierOutcome, host: TierOutcome, dcfg: DifferentialConfig
+) -> dict:
+    """Tolerance verdict for one spec: rate deltas in per-mille, plus
+    the exact history-agreement requirements."""
+    n = dcfg.seeds
+    deltas = {
+        "elected_pm": abs(_pm(dev.elected_seeds, n) - _pm(host.elected_seeds, n)),
+        "no_leader_pm": abs(
+            _pm(dev.no_leader_seeds, n) - _pm(host.no_leader_seeds, n)
+        ),
+        "violation_pm": abs(
+            _pm(dev.violation_seeds, n) - _pm(host.violation_seeds, n)
+        ),
+    }
+    ok = (
+        deltas["elected_pm"] <= dcfg.tol_elected_pm
+        and deltas["no_leader_pm"] <= dcfg.tol_no_leader_pm
+        and deltas["violation_pm"] <= dcfg.tol_violation_pm
+        # the sequential spec must agree with each tier's own latch,
+        # seed by seed — and no device lane may have been truncated
+        # (clipped history buffer or overflowed event queue)
+        and dev.hist_mismatch_seeds == 0
+        and host.hist_mismatch_seeds == 0
+        and dev.hist_overflow_seeds == 0
+        and dev.overflow_seeds == 0
+    )
+    return {"deltas": deltas, "pass": ok}
+
+
+def run_differential(
+    specs: Sequence,
+    dcfg: DifferentialConfig = DifferentialConfig(),
+    report_path: Optional[str] = None,
+) -> dict:
+    """Run the matched grid for every spec; returns (and optionally
+    writes, as canonical JSON) the full report. ``report["pass"]`` is
+    the gate verdict: every spec's tolerance check held."""
+    records: List[dict] = []
+    for spec in specs:
+        dev = device_outcomes(spec, dcfg)
+        host = host_outcomes(spec, dcfg)
+        verdict = compare(dev, host, dcfg)
+        records.append(
+            {
+                "spec": spec_to_dict(spec),
+                "device": dev._asdict(),
+                "host": host._asdict(),
+                **verdict,
+            }
+        )
+    report = {
+        "config": {
+            **dcfg._asdict(),
+            # floats are kept out of the canonical encoding
+            "sim_seconds": None,
+            "sim_ns": int(dcfg.sim_seconds * 1e9),
+        },
+        "grid": [dcfg.seed0, dcfg.seed0 + dcfg.seeds],
+        "specs": records,
+        "pass": all(r["pass"] for r in records),
+    }
+    if report_path is not None:
+        with open(report_path, "w") as f:
+            f.write(json.dumps(report, sort_keys=True) + "\n")
+    return report
+
+
+def gate_specs() -> List[FaultSpec]:
+    """The differential gate's spec set: a clean-ish crash-storm
+    baseline plus one spec per gray-failure family (asymmetric
+    partitions, fsync-stall + power-fail, clock skew) — every window
+    well inside the default 2 s horizon so the full fault environment
+    transfers to both tiers."""
+    return [
+        # crash storm (the amnesia baseline both tiers find violations in)
+        FaultSpec(
+            crashes=3,
+            crash_window_ns=1_200_000_000,
+            restart_lo_ns=50_000_000,
+            restart_hi_ns=300_000_000,
+        ),
+        # asymmetric partitions: one-directional link loss
+        FaultSpec(
+            crashes=1,
+            crash_window_ns=1_000_000_000,
+            restart_lo_ns=50_000_000,
+            restart_hi_ns=300_000_000,
+            aparts=2,
+            apart_window_ns=1_200_000_000,
+            apart_lo_ns=200_000_000,
+            apart_hi_ns=600_000_000,
+        ),
+        # slow disks + power loss: crash-without-sync
+        FaultSpec(
+            fsync_stalls=2,
+            fsync_window_ns=1_200_000_000,
+            fsync_lo_ns=300_000_000,
+            fsync_hi_ns=800_000_000,
+            power_fails=2,
+            power_window_ns=1_200_000_000,
+            power_lo_ns=50_000_000,
+            power_hi_ns=300_000_000,
+        ),
+        # clock skew: a drifting node's timers stretch 1.5x
+        FaultSpec(
+            crashes=1,
+            crash_window_ns=1_000_000_000,
+            restart_lo_ns=50_000_000,
+            restart_hi_ns=300_000_000,
+            skews=2,
+            skew_window_ns=1_200_000_000,
+            skew_lo_ns=300_000_000,
+            skew_hi_ns=900_000_000,
+        ),
+    ]

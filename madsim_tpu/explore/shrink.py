@@ -74,13 +74,15 @@ class ShrinkResult(NamedTuple):
 
 
 def to_fixed(spec, events: Sequence[FaultEvent]) -> FixedFaults:
-    """Refit a schedule as a literal spec, carrying over the burst
-    override values (both spec flavors have them)."""
+    """Refit a schedule as a literal spec, carrying over the burst and
+    clock-skew override values (both spec flavors have them)."""
     return FixedFaults(
         events=tuple(events),
         spike_lat_lo_ns=spec.spike_lat_lo_ns,
         spike_lat_hi_ns=spec.spike_lat_hi_ns,
         burst_loss_q32=spec.burst_loss_q32,
+        skew_num=spec.skew_num,
+        skew_den=spec.skew_den,
     )
 
 

@@ -11,9 +11,12 @@ the host tier:
 - ``apply_schedule`` is the async supervisor task: it sleeps to each
   event's virtual time and applies it through the live simulation's
   public APIs — ``Handle.kill/restart/pause/resume`` for crash/restart/
-  pause events (ref runtime/mod.rs:272-303) and the ``NetSim`` fault
-  surface (``clog_node``/``unclog_node``, latency/loss config) for
-  partition and burst events (ref net/mod.rs:163-284).
+  pause events (ref runtime/mod.rs:272-303), the ``NetSim`` fault
+  surface (directional ``clog_node_in/out``, latency/loss config) for
+  partition and burst events (ref net/mod.rs:163-284), the ``FsSim``
+  durability surface (``stall_fsync``/``unstall_fsync``/``power_fail``)
+  for the slow-disk and power-fail gray failures, and the per-node
+  clock-skew registry on ``TimeHandle`` for skew windows.
 - ``run_campaign`` composes the two: one call drives a whole campaign
   against a list of nodes.
 
@@ -80,10 +83,12 @@ async def apply_schedule(
 
     ``nodes[victim]`` maps schedule victims to node handles (any
     ``NodeRef``). ``spec`` is only required when the schedule contains
-    latency-spike or loss-burst events (it carries the override values).
-    Must run inside a simulation (a supervisor task, like the manual
-    kill/clog loops it replaces)."""
+    latency-spike, loss-burst or clock-skew events (it carries the
+    override values; ``FixedFaults`` carries them too). Must run inside
+    a simulation (a supervisor task, like the manual kill/clog loops it
+    replaces)."""
     from .context import current_handle
+    from .fs import FsSim
     from .net import NetSim
     from .runtime import _node_id
     from .time import elapsed, sleep
@@ -93,11 +98,30 @@ async def apply_schedule(
 
     dead = [False] * len(nodes)
     paused = [False] * len(nodes)
-    part_cnt = [0] * len(nodes)
+    # per-direction partition refcounts (mirrors FaultState.part_in_cnt /
+    # part_out_cnt): a symmetric partition holds both directions, an
+    # asymmetric window one — a heal never un-clogs a direction an
+    # overlapping asymmetric window still holds, and vice versa
+    part_in_cnt = [0] * len(nodes)
+    part_out_cnt = [0] * len(nodes)
+    fsync_cnt = [0] * len(nodes)
+    skew_cnt = [0] * len(nodes)
     spike_cnt = 0
     loss_cnt = 0
     base_latency = ns.config.net.send_latency
     base_loss = ns.config.net.packet_loss_rate
+
+    def _clog_dir(victim: int, cnt, clog, unclog, delta: int) -> None:
+        """Refcounted one-direction clog: apply on 0->1, restore on 1->0."""
+        nid = _node_id(nodes[victim])
+        if delta > 0:
+            if cnt[victim] == 0:
+                clog(nid)
+            cnt[victim] += 1
+        else:
+            if cnt[victim] == 1:
+                unclog(nid)
+            cnt[victim] = max(cnt[victim] - 1, 0)
 
     def _set_net(latency=None, loss=None):
         # NetSim and its Network normally share one Config object; write
@@ -120,8 +144,14 @@ async def apply_schedule(
         dt = t_ns / 1e9 - elapsed()
         if dt > 0:
             await sleep(dt)
-        if action == "crash":
+        if action in ("crash", "power_fail"):
+            # both flavors drop unsynced storage: Handle.kill resets every
+            # simulator (FsSim.reset_node == power_fail); the power_fail
+            # action drives the fs machinery explicitly as well, so the
+            # storage edge fires even under a custom fs configuration
             if not dead[victim]:
+                if action == "power_fail":
+                    h.simulator(FsSim).power_fail(_node_id(nodes[victim]))
                 h.kill(nodes[victim])
                 dead[victim] = True
                 paused[victim] = False
@@ -130,13 +160,38 @@ async def apply_schedule(
                 h.restart(nodes[victim])
                 dead[victim] = False
         elif action == "partition":
-            if part_cnt[victim] == 0:
-                ns.clog_node(_node_id(nodes[victim]))
-            part_cnt[victim] += 1
+            _clog_dir(victim, part_in_cnt, ns.clog_node_in, ns.unclog_node_in, +1)
+            _clog_dir(victim, part_out_cnt, ns.clog_node_out, ns.unclog_node_out, +1)
         elif action == "heal":
-            if part_cnt[victim] == 1:
-                ns.unclog_node(_node_id(nodes[victim]))
-            part_cnt[victim] = max(part_cnt[victim] - 1, 0)
+            _clog_dir(victim, part_in_cnt, ns.clog_node_in, ns.unclog_node_in, -1)
+            _clog_dir(victim, part_out_cnt, ns.clog_node_out, ns.unclog_node_out, -1)
+        elif action == "part_in":
+            _clog_dir(victim, part_in_cnt, ns.clog_node_in, ns.unclog_node_in, +1)
+        elif action == "heal_in":
+            _clog_dir(victim, part_in_cnt, ns.clog_node_in, ns.unclog_node_in, -1)
+        elif action == "part_out":
+            _clog_dir(victim, part_out_cnt, ns.clog_node_out, ns.unclog_node_out, +1)
+        elif action == "heal_out":
+            _clog_dir(victim, part_out_cnt, ns.clog_node_out, ns.unclog_node_out, -1)
+        elif action == "fsync_stall":
+            if fsync_cnt[victim] == 0:
+                h.simulator(FsSim).stall_fsync(_node_id(nodes[victim]))
+            fsync_cnt[victim] += 1
+        elif action == "fsync_ok":
+            if fsync_cnt[victim] == 1:
+                h.simulator(FsSim).unstall_fsync(_node_id(nodes[victim]))
+            fsync_cnt[victim] = max(fsync_cnt[victim] - 1, 0)
+        elif action == "skew_on":
+            s = _needs_spec()
+            if skew_cnt[victim] == 0:
+                h.time.set_node_skew(
+                    _node_id(nodes[victim]), s.skew_num, s.skew_den
+                )
+            skew_cnt[victim] += 1
+        elif action == "skew_off":
+            if skew_cnt[victim] == 1:
+                h.time.clear_node_skew(_node_id(nodes[victim]))
+            skew_cnt[victim] = max(skew_cnt[victim] - 1, 0)
         elif action == "spike_on":
             spike_cnt += 1
             if spike_cnt == 1:

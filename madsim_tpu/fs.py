@@ -9,6 +9,13 @@ a per-inode shadow state until ``sync_all``; node kill/restart triggers
 never-synced files disappear, and unsynced removals are resurrected.
 ``remove_file(durable=True)`` opts into an immediately-durable unlink
 (the "journaled fs + directory fsync" model).
+
+Gray failures (docs/faults.md): a fault schedule can open a *slow-disk
+window* on a node (``FsSim.stall_fsync``/``unstall_fsync``) during which
+``sync_all`` defers — the call returns but nothing becomes durable until
+the window closes; the schedule's ``power_fail`` action drives
+``FsSim.power_fail`` directly, so crash-without-sync is a first-class
+campaign fault rather than a side effect of kill.
 """
 
 from __future__ import annotations
@@ -21,13 +28,21 @@ from .task import NodeId
 
 
 class _INode:
-    __slots__ = ("synced", "dirty", "removed")
+    __slots__ = ("synced", "dirty", "removed", "sync_requested",
+                 "remove_requested")
 
     def __init__(self, durable: bool = False) -> None:
         # synced=None => the file has never been made durable
         self.synced: Optional[bytearray] = bytearray() if durable else None
         self.dirty: Optional[bytearray] = None  # copy-on-write until sync
         self.removed = False  # unsynced unlink tombstone
+        # slow-disk bookkeeping (fsync-stall windows, engine/faults.py
+        # gray failures): a sync issued while the node's disk is stalled
+        # defers — the flag marks it pending so ``unstall_fsync`` can
+        # apply it; a durable unlink issued while stalled likewise defers
+        # its directory fsync
+        self.sync_requested = False
+        self.remove_requested = False
 
     def data(self) -> bytearray:
         if self.dirty is not None:
@@ -43,6 +58,8 @@ class _INode:
 
     def sync(self) -> None:
         self.removed = False
+        self.sync_requested = False
+        self.remove_requested = False
         if self.dirty is not None:
             self.synced = self.dirty
             self.dirty = None
@@ -54,6 +71,8 @@ class _INode:
         (it was never synced)."""
         self.dirty = None
         self.removed = False
+        self.sync_requested = False
+        self.remove_requested = False
         return self.synced is not None
 
 
@@ -63,6 +82,7 @@ class FsSim(Simulator):
     def __init__(self, rng, time, config):
         super().__init__(rng, time, config)
         self._nodes: Dict[NodeId, Dict[str, _INode]] = {}
+        self._fsync_stalled: set = set()  # nodes inside a slow-disk window
 
     def create_node(self, id: NodeId) -> None:
         self._nodes.setdefault(id, {})
@@ -80,6 +100,29 @@ class FsSim(Simulator):
         for path in list(table):
             if not table[path].power_fail():
                 del table[path]
+
+    # -- slow-disk windows (gray failures, docs/faults.md) -----------------
+
+    def fsync_stalled(self, id: NodeId) -> bool:
+        return id in self._fsync_stalled
+
+    def stall_fsync(self, id: NodeId) -> None:
+        """Open a slow-disk window: syncs issued on the node defer (the
+        write cache absorbs them — nothing becomes durable) until
+        ``unstall_fsync``. A power fail inside the window drops them."""
+        self._fsync_stalled.add(id)
+
+    def unstall_fsync(self, id: NodeId) -> None:
+        """Close the window: the disk catches up — every deferred sync
+        applies, deferred durable unlinks finalize."""
+        self._fsync_stalled.discard(id)
+        table = self._table(id)
+        for path in list(table):
+            inode = table[path]
+            if inode.remove_requested:
+                del table[path]
+            elif inode.sync_requested and not inode.removed:
+                inode.sync()
 
     def get_file_size(self, id: NodeId, path: str) -> int:
         inode = self._table(id).get(str(path))
@@ -124,6 +167,9 @@ class File:
             inode = _INode()
             table[str(path)] = inode
         inode.removed = False
+        # re-creating the path supersedes any deferred durable unlink
+        # (else unstall_fsync would delete the re-created file)
+        inode.remove_requested = False
         inode.dirty = bytearray()
         return File(inode, str(path))
 
@@ -136,6 +182,7 @@ class File:
                 inode = _INode()
                 table[str(path)] = inode
             inode.removed = False
+            inode.remove_requested = False
             inode.dirty = bytearray()
         return File(inode, str(path))
 
@@ -164,7 +211,13 @@ class File:
             data.extend(b"\x00" * (size - len(data)))
 
     async def sync_all(self) -> None:
-        self._inode.sync()
+        # inside a slow-disk window the sync defers: the call returns (the
+        # lying write cache) but durability is pending — a power fail
+        # before the window closes drops the data (docs/faults.md)
+        if _fs().fsync_stalled(current_node().id):
+            self._inode.sync_requested = True
+        else:
+            self._inode.sync()
 
     async def metadata(self) -> "Metadata":
         return Metadata(len(self._inode.data()))
@@ -206,7 +259,14 @@ async def remove_file(path: str, durable: bool = False) -> None:
     inode = table.get(str(path))
     if inode is None or inode.removed:
         raise FileNotFoundError(path)
-    if durable or inode.synced is None:
+    if durable and _fs().fsync_stalled(current_node().id):
+        # the directory fsync defers with the rest of the stalled disk:
+        # tombstone now, finalize at unstall — a power fail in between
+        # resurrects the file, exactly like a buffered removal
+        inode.removed = True
+        inode.dirty = None
+        inode.remove_requested = True
+    elif durable or inode.synced is None:
         del table[str(path)]
     else:
         inode.removed = True

@@ -38,12 +38,11 @@ Design notes:
 - A keepalive for a lease that is not live (re)grants it — clients own a
   fixed lease slot and heartbeat it, the etcd-session usage pattern.
 - Partition windows come from the shared fault compiler
-  (``engine/faults.py``) and are refcounted per victim
-  (``FaultState.part_cnt``), so overlapping windows of the same client
-  compose exactly. Overlapping windows of *different* clients can still
-  unclog each other's two shared link cells early (clog_node sets whole
-  rows/cols); the fault pattern is slightly weaker in that corner,
-  determinism is unaffected.
+  (``engine/faults.py``) and are refcounted per victim PER DIRECTION
+  (``FaultState.part_in_cnt``/``part_out_cnt``); the clog matrix is
+  derived from the refcounts, so overlapping windows — same victim,
+  different victims sharing a link cell, symmetric over asymmetric —
+  all compose exactly.
 """
 
 from __future__ import annotations
@@ -254,7 +253,10 @@ def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
         _pay(SERVER, MT_PUT, node, put_key, val, put_lease, opid),
         _pay(SERVER, MT_GET, node, key_draw, 0, 0, opid),
     )
-    interval = bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns)
+    interval = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns),
+    )
     emits = _emits2(
         (t, K_MSG, msg, sent),
         (now + interval, K_OP, _pay(c), True),
@@ -279,7 +281,10 @@ def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     node = _client_node(c)
     can_send = get1(efaults.up(w.fstate), node)
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
-    interval = bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns)
+    interval = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns),
+    )
     # opid -1: lease traffic carries no history opid, so its reply can
     # never alias a pending KV op's completion record
     emits = _emits2(
@@ -306,7 +311,11 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     lease = a
     was_on = get1(w.lease_on, lease)
     new_gen = get1(w.lease_gen, lease) + 1
-    new_exp = now + cfg.ttl_ns
+    # the expiry deadline is a SERVER timer: a skewed server clock
+    # stretches the TTL countdown (keys linger — the gray failure)
+    new_exp = now + efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.ttl_ns
+    )
     lease_on2 = set1(w.lease_on, lease, True, is_lease)
     lease_exp2 = set1(w.lease_exp, lease, new_exp, is_lease)
     lease_gen2 = set1(w.lease_gen, lease, new_gen, is_lease)
@@ -450,10 +459,15 @@ def _on_fault(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     links2, f2, _edges = efaults.on_event(
         fault_spec(cfg), base, w.links, w.fstate, action, victim
     )
+    part_like = (
+        (action == efaults.F_PART)
+        | (action == efaults.F_PART_IN)
+        | (action == efaults.F_PART_OUT)
+    )
     w2 = w._replace(
         links=links2,
         fstate=f2,
-        parts=w.parts + jnp.where(action == efaults.F_PART, 1, 0),
+        parts=w.parts + jnp.where(part_like, 1, 0),
     )
     return w2, _emits2(None, None)
 

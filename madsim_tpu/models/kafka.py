@@ -211,7 +211,10 @@ def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     t, deliver = enet.route(w.links, now, node, BROKER, rand[0], rand[1])
     send = active & deliver
     msg = _pay(BROKER, MT_PRODUCE, node, seq)
-    interval = bounded(rand[2], cfg.produce_lo_ns, cfg.produce_hi_ns)
+    interval = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[2], cfg.produce_lo_ns, cfg.produce_hi_ns),
+    )
     emits = _emits(
         cfg,
         _no_bcast(cfg),
@@ -240,7 +243,10 @@ def _on_fetch_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     sent = can_send & deliver
     opid = get1(w.cons_opid, c)
     msg = _pay(BROKER, MT_FETCH, node, get1(w.cons_off, c), 0, opid)
-    interval = bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns)
+    interval = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns),
+    )
     emits = _emits(
         cfg,
         _no_bcast(cfg),
@@ -371,25 +377,32 @@ def _on_msg(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
 def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     """Advance the durable watermark to the log end; in correct mode this
     is also the ack point — one cumulative ack per producer whose durable
-    frontier moved."""
+    frontier moved.
+
+    The broker's flush IS its fsync: inside a slow-disk window
+    (engine/faults ``fsync_stall``) the timer keeps ticking but the
+    watermark freezes — nothing becomes durable until the window closes,
+    so a crash/power_fail meanwhile loses every entry past the stalled
+    frontier (and, in bug_ack_on_append mode, acknowledged data)."""
     gen = pay[0]
     valid = get1(efaults.up(w.fstate), BROKER) & (gen == w.bgen)
-    flushed2 = jnp.where(valid, w.log_len, w.flushed)
+    do_flush = valid & ~get1(efaults.stalled(w.fstate), BROKER)
+    flushed2 = jnp.where(do_flush, w.log_len, w.flushed)
     dur2 = jnp.where(
-        valid,
+        do_flush,
         _compute_dur_upto(cfg, w.log_src, w.log_seq, flushed2),
         w.dur_upto,
     )
     # watermark sanity: the durable watermark must not already exceed the
     # log end when the flush fires (checked pre-update; post-update the
     # two are equal by construction)
-    bad_wm = valid & jnp.any(w.flushed > w.log_len)
+    bad_wm = do_flush & jnp.any(w.flushed > w.log_len)
 
     if cfg.bug_ack_on_append:
         ack2 = w.ack_upto  # acks already went out at append time
         advanced = jnp.zeros((cfg.num_producers,), bool)
     else:
-        advanced = valid & (dur2 > w.ack_upto)
+        advanced = do_flush & (dur2 > w.ack_upto)
         ack2 = jnp.where(advanced, dur2, w.ack_upto)
 
     # broadcast slots: one cumulative ack per producer with a moved
@@ -416,17 +429,20 @@ def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     enables = slot_adv & deliver
     bcast = (times, jnp.full((n,), K_MSG, jnp.int32), pays, enables)
 
+    flush_dt = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, jnp.int32(BROKER), cfg.flush_interval_ns
+    )
     emits = _emits(
         cfg,
         bcast,
-        (now + cfg.flush_interval_ns, K_FLUSH, _pay(gen), valid),
+        (now + flush_dt, K_FLUSH, _pay(gen), valid),
         _DISABLED,
     )
     w2 = w._replace(
         flushed=flushed2,
         dur_upto=dur2,
         ack_upto=ack2,
-        flushes=w.flushes + jnp.where(valid, 1, 0),
+        flushes=w.flushes + jnp.where(do_flush, 1, 0),
         vio_watermark=w.vio_watermark | bad_wm,
         violation=w.violation | bad_wm,
         viol_kind=w.viol_kind
@@ -477,10 +493,13 @@ def _on_fault(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
         | jnp.where(crashed & bad_wm, jnp.int32(V_WATERMARK), jnp.int32(0)),
         crash_count=w.crash_count + jnp.where(crashed, 1, 0),
     )
+    flush_dt = efaults.skewed_delay(
+        fault_spec(cfg), f2, jnp.int32(BROKER), cfg.flush_interval_ns
+    )
     emits = _emits(
         cfg,
         _no_bcast(cfg),
-        (now + cfg.flush_interval_ns, K_FLUSH, _pay(bgen2), revived),
+        (now + flush_dt, K_FLUSH, _pay(bgen2), revived),
         _DISABLED,
     )
     return w2, emits

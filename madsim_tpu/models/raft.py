@@ -53,6 +53,7 @@ from ..engine import net as enet
 from ..engine.core import Emits, EngineConfig, Workload
 from ..engine.ops import get1, get2, geti, set1, set2
 from ..engine.rng import bounded, prob_to_q32
+from ..oracle.history import OP_ELECT, PH_INVOKE
 from . import _common
 
 # event kinds
@@ -121,6 +122,12 @@ class RaftConfig(NamedTuple):
     # vote. Used by the cross-tier replay pipeline (madsim_tpu/replay.py)
     # to find device seeds whose fault schedule breaks host-tier user code.
     volatile_state: bool = False
+    # operation-history buffer rows per seed (madsim_tpu/oracle); 0 =
+    # recording off. Raft records one OP_ELECT invoke row per won
+    # election (client = winner node, key = term) — the history the
+    # differential harness checks against oracle.specs.ElectionSpec on
+    # both tiers (explore/differential.py).
+    hist_slots: int = 0
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a crash-storm spec from the legacy fields above
     faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
@@ -139,6 +146,21 @@ def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
     )
 
 
+def _shadow_nodes(cfg: RaftConfig) -> int:
+    """Width of the durability-shadow planes: ``num_nodes`` iff the
+    (static, jit-cache-key) spec can open a slow-disk window. Without
+    fsync stalls the shadow provably equals the live durable state after
+    every event, so the planes go width-0 and every shadow write and
+    crash rollback is gated off at trace time — the no-stall common case
+    (all pre-gray configs, the headline benchmarks) pays nothing."""
+    spec = fault_spec(cfg)
+    if isinstance(spec, efaults.FixedFaults):
+        stalls = any(a == "fsync_stall" for _, a, _ in spec.events)
+    else:
+        stalls = spec.fsync_stalls > 0
+    return cfg.num_nodes if stalls else 0
+
+
 class RaftState(NamedTuple):
     # per-node Raft state [N] (term/voted/log are durable across crashes)
     role: jnp.ndarray  # int32
@@ -152,6 +174,20 @@ class RaftState(NamedTuple):
     # replicated log [N, L]: term of each entry; slot 0 is the sentinel
     log_term: jnp.ndarray  # int32[N, L]
     log_len: jnp.ndarray  # int32[N] (== last used index; entries 1..len)
+    # durability plane (gray failures, docs/faults.md): the SYNCED shadow
+    # of the durable state. Outside slow-disk windows the shadow tracks
+    # the live values event by event (fsync-on-mutate, the correct-raft
+    # discipline); inside a window it freezes, and a crash/power_fail
+    # rolls the live values back to it — crash-without-sync as a
+    # schedulable fault. The model acks before fsync completes (the
+    # realistic bug class), so stall+power_fail campaigns CAN surface
+    # genuine election/commit-safety violations. All four planes are
+    # width-0 (and the writes statically gated off) when the spec draws
+    # no fsync-stall windows — see ``_shadow_nodes``.
+    dur_term: jnp.ndarray  # int32[SN]  (SN = num_nodes or 0)
+    dur_voted: jnp.ndarray  # int32[SN]
+    dur_log_term: jnp.ndarray  # int32[SN, L]
+    dur_log_len: jnp.ndarray  # int32[SN]
     commit: jnp.ndarray  # int32[N] (volatile)
     next_idx: jnp.ndarray  # int32[N, N] (leader bookkeeping, volatile)
     match_idx: jnp.ndarray  # int32[N, N]
@@ -297,7 +333,11 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
         cfg, w2, now, node, rand, starting,
         _pays(cfg, M_REQ_VOTE, node, new_term, last_idx, last_term),
     )
-    timeout = bounded(rand[2 * cfg.num_nodes], cfg.election_lo_ns, cfg.election_hi_ns)
+    # timer arming runs on the node's own (possibly skewed) clock
+    timeout = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[2 * cfg.num_nodes], cfg.election_lo_ns, cfg.election_hi_ns),
+    )
     emits = _emits(
         cfg,
         bcast,
@@ -318,10 +358,11 @@ def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     bcast, sent, delivered = _broadcast(
         cfg, w, now, node, rand, valid, _append_pays(cfg, w, node, term)
     )
+    hb = efaults.skewed_delay(fault_spec(cfg), w.fstate, node, cfg.heartbeat_ns)
     emits = _emits(
         cfg,
         bcast,
-        (now + cfg.heartbeat_ns, K_HEARTBEAT, _pay(node, epoch), valid),
+        (now + hb, K_HEARTBEAT, _pay(node, epoch), valid),
         _DISABLED_EXTRA,
     )
     w2 = w._replace(msgs_sent=w.msgs_sent + sent, msgs_delivered=w.msgs_delivered + delivered)
@@ -478,13 +519,17 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     )
     attempt_reply = (grant | is_ap) & live
     send_reply = attempt_reply & rdeliver
-    extra_time = jnp.where(won, now + cfg.heartbeat_ns, rt)
+    hb = efaults.skewed_delay(fault_spec(cfg), w.fstate, dst, cfg.heartbeat_ns)
+    extra_time = jnp.where(won, now + hb, rt)
     extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
     extra_pay = jnp.where(won, _pay(dst, get1(w2.lepoch, dst)), reply_pay)
     extra_on = won | (send_reply & ~won)
     # extra slot 2: the demoted ex-leader's fresh election timer
-    retimeout = bounded(
-        rand[2 * cfg.num_nodes + 2], cfg.election_lo_ns, cfg.election_hi_ns
+    retimeout = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, dst,
+        bounded(
+            rand[2 * cfg.num_nodes + 2], cfg.election_lo_ns, cfg.election_hi_ns
+        ),
     )
     emits = _emits(
         cfg,
@@ -528,6 +573,23 @@ def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
     stopped = crashed | e.paused  # the node's event chains must die
     revived = restarted | resumed  # the node needs a fresh timer chain
 
+    rollback = {}
+    if _shadow_nodes(cfg):
+        # durability rollback (crash OR power_fail edge): the "durable"
+        # state reverts to its synced shadow — an identity outside
+        # slow-disk windows, where every mutation synced immediately.
+        # Statically absent when the spec draws no stall windows (the
+        # shadow planes are width-0 then).
+        rollback = dict(
+            term=set1(w.term, victim, get1(w.dur_term, victim), crashed),
+            voted=set1(w.voted, victim, get1(w.dur_voted, victim), crashed),
+            log_len=set1(
+                w.log_len, victim, get1(w.dur_log_len, victim), crashed
+            ),
+            log_term=set1(
+                w.log_term, victim, get1(w.dur_log_term, victim), crashed
+            ),
+        )
     w2 = w._replace(
         links=links2,
         fstate=f2,
@@ -537,20 +599,33 @@ def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
         tgen=set1(w.tgen, victim, get1(w.tgen, victim) + 1, stopped),
         lepoch=set1(w.lepoch, victim, get1(w.lepoch, victim) + 1, stopped),
         last_hb=set1(w.last_hb, victim, now, revived),
+        **rollback,
     )
     if cfg.volatile_state:
         # amnesia mode: the "durable" state dies with the process too
-        # (what host-tier code that keeps everything in memory does)
+        # (what host-tier code that keeps everything in memory does) —
+        # the shadows are wiped as well, else the NEXT crash would
+        # resurrect pre-amnesia state out of them
+        zlog = jnp.zeros((cfg.log_cap,), jnp.int32)
         w2 = w2._replace(
             term=set1(w2.term, victim, 0, crashed),
             voted=set1(w2.voted, victim, -1, crashed),
             log_len=set1(w2.log_len, victim, 0, crashed),
-            log_term=set1(
-                w2.log_term, victim, jnp.zeros((cfg.log_cap,), jnp.int32), crashed
-            ),
+            log_term=set1(w2.log_term, victim, zlog, crashed),
         )
-    timeout = bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns)
+        if _shadow_nodes(cfg):
+            w2 = w2._replace(
+                dur_term=set1(w2.dur_term, victim, 0, crashed),
+                dur_voted=set1(w2.dur_voted, victim, -1, crashed),
+                dur_log_len=set1(w2.dur_log_len, victim, 0, crashed),
+                dur_log_term=set1(w2.dur_log_term, victim, zlog, crashed),
+            )
+    timeout = efaults.skewed_delay(
+        fault_spec(cfg), f2, victim,
+        bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns),
+    )
     still_leader = get1(w2.role, victim) == LEADER  # only a resumed leader
+    hb = efaults.skewed_delay(fault_spec(cfg), f2, victim, cfg.heartbeat_ns)
     emits = _emits(
         cfg,
         _no_bcast(cfg),
@@ -561,7 +636,7 @@ def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
             revived & ~still_leader,
         ),
         (
-            now + cfg.heartbeat_ns,
+            now + hb,
             K_HEARTBEAT,
             _pay(victim, get1(w2.lepoch, victim)),
             resumed & still_leader,
@@ -637,6 +712,33 @@ def _probe(w: RaftState):
     return w.viol_kind
 
 
+def _record(cfg: RaftConfig, wb: RaftState, wa: RaftState, now, kind, pay):
+    """Map one dispatched event to its op-history record (engine
+    contract: ``Workload.record`` — at most ONE row per event).
+
+    Raft records leadership: each won election appends one OP_ELECT
+    *invoke* row (client = winner node, key = the won term, inp = the
+    node again; there is no client-observed completion, so the op stays
+    open — ``oracle.specs.ElectionSpec`` is a structural check over
+    invoke rows). The host tier records the same rows through
+    ``HostRecorder`` in ``examples/raft_host.py``, so one sequential
+    spec checks both tiers (explore/differential.py)."""
+    won = wa.elections > wb.elections
+    # the only win sites are K_MSG handlers, where pay[0] is the winner
+    node = jnp.clip(pay[0], 0, cfg.num_nodes - 1)
+    term = get1(wa.term, node)
+    rec = jnp.stack(
+        [
+            node,
+            jnp.full((), OP_ELECT * 2 + PH_INVOKE, jnp.int32),
+            term,
+            node,
+            wb.elections,  # opid: the global election counter
+        ]
+    )
+    return rec, won
+
+
 def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
     branches = [
         partial(_on_election_timer, cfg),
@@ -645,7 +747,22 @@ def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
         partial(_on_fault, cfg),
         partial(_on_cmd, cfg),
     ]
-    return jax.lax.switch(kind, branches, w, now, pay, rand)
+    w2, emits = jax.lax.switch(kind, branches, w, now, pay, rand)
+    # durability plane: fsync-on-mutate — after every event each node's
+    # synced shadow catches up to the live durable state UNLESS a
+    # slow-disk window holds its fsync (engine/faults.stalled), in which
+    # case the shadow freezes and a crash/power_fail rolls back to it.
+    # One vectorized masked write per event, statically gated off (with
+    # width-0 planes) for specs that draw no stall windows.
+    if _shadow_nodes(cfg):
+        sync = ~efaults.stalled(w2.fstate)
+        w2 = w2._replace(
+            dur_term=jnp.where(sync, w2.term, w2.dur_term),
+            dur_voted=jnp.where(sync, w2.voted, w2.dur_voted),
+            dur_log_len=jnp.where(sync, w2.log_len, w2.dur_log_len),
+            dur_log_term=jnp.where(sync[:, None], w2.log_term, w2.dur_log_term),
+        )
+    return w2, emits
 
 
 def _init(cfg: RaftConfig, key):
@@ -670,6 +787,10 @@ def _init(cfg: RaftConfig, key):
         lepoch=jnp.zeros((n,), jnp.int32),
         log_term=jnp.zeros((n, cfg.log_cap), jnp.int32),
         log_len=jnp.zeros((n,), jnp.int32),
+        dur_term=jnp.zeros((_shadow_nodes(cfg),), jnp.int32),
+        dur_voted=jnp.full((_shadow_nodes(cfg),), -1, jnp.int32),
+        dur_log_term=jnp.zeros((_shadow_nodes(cfg), cfg.log_cap), jnp.int32),
+        dur_log_len=jnp.zeros((_shadow_nodes(cfg),), jnp.int32),
         commit=jnp.zeros((n,), jnp.int32),
         next_idx=jnp.ones((n, n), jnp.int32),
         match_idx=jnp.zeros((n, n), jnp.int32),
@@ -731,6 +852,8 @@ def workload(cfg: RaftConfig = None) -> Workload:
         cover=partial(_cover, cfg),
         cover_bits=cover_bits(cfg),
         probe=_probe,
+        record=partial(_record, cfg) if cfg.hist_slots > 0 else None,
+        hist_slots=cfg.hist_slots,
     )
 
 

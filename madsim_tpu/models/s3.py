@@ -245,7 +245,10 @@ def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
     node = jnp.asarray(c, jnp.int32) + 1
     t, deliver = enet.route(w.links, now, node, SERVER, rand[0], rand[1])
     send = active & deliver
-    interval = bounded(rand[2], cfg.op_lo_ns, cfg.op_hi_ns)
+    interval = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node,
+        bounded(rand[2], cfg.op_lo_ns, cfg.op_hi_ns),
+    )
     emits = _emits(
         (t, K_MSG, _pay(SERVER, mtype, node, a, b), send),
         (now + interval, K_OP, _pay(c), (phase2 != IDLE) | budget_left),
@@ -474,12 +477,18 @@ def _on_flush(cfg: S3Config, w: S3State, now, pay, rand):
     _on_restart)."""
     gen = pay[0]
     valid = get1(efaults.up(w.fstate), SERVER) & (gen == w.sgen)
+    # the flush is the server's fsync: a slow-disk window (engine/faults
+    # ``fsync_stall``) freezes the durable tier while the timer ticks on
+    do_flush = valid & ~get1(efaults.stalled(w.fstate), SERVER)
     w2 = w._replace(
-        ver_dur=jnp.where(valid, w.ver_com, w.ver_dur),
-        len_dur=jnp.where(valid, w.len_com, w.len_dur),
+        ver_dur=jnp.where(do_flush, w.ver_com, w.ver_dur),
+        len_dur=jnp.where(do_flush, w.len_com, w.len_dur),
+    )
+    flush_dt = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.flush_interval_ns
     )
     emits = _emits(
-        (now + cfg.flush_interval_ns, K_FLUSH, _pay(gen), valid),
+        (now + flush_dt, K_FLUSH, _pay(gen), valid),
         _DISABLED,
     )
     return w2, emits

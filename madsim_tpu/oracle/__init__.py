@@ -30,7 +30,7 @@ from .history import (
     decode_sweep,
     history_bytes,
 )
-from .specs import KVSpec, LogSpec
+from .specs import ElectionSpec, KVSpec, LogSpec
 
 __all__ = [
     "CheckResult",
@@ -44,6 +44,7 @@ __all__ = [
     "decode_seed",
     "decode_sweep",
     "history_bytes",
+    "ElectionSpec",
     "KVSpec",
     "LogSpec",
 ]

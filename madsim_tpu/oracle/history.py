@@ -31,8 +31,10 @@ OP_GET = 1  # read key; out = value or -1 (absent)
 OP_DEL = 2  # delete key (internal ops record invoke == complete)
 OP_PRODUCE = 3  # append inp (seq) to log/partition key; out = ack frontier
 OP_FETCH = 4  # read from offset inp of partition key; out = records served
+OP_ELECT = 5  # node inp won leadership of term key (invoke-only: no
+#               client observes a completion — ElectionSpec is structural)
 
-OP_NAMES = ("put", "get", "del", "produce", "fetch")
+OP_NAMES = ("put", "get", "del", "produce", "fetch", "elect")
 
 PH_INVOKE = 0
 PH_OK = 1

@@ -27,7 +27,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional, Sequence, Tuple
 
-from .history import OP_DEL, OP_FETCH, OP_GET, OP_PRODUCE, OP_PUT, Op
+from .history import OP_DEL, OP_ELECT, OP_FETCH, OP_GET, OP_PRODUCE, OP_PUT, Op
 
 ABSENT = -1  # the value-column encoding of "key not present"
 
@@ -136,4 +136,49 @@ class LogSpec(Spec):
                     f"offset was {expect}"
                 )
             pos[(op.client, op.key)] = op.inp + op.out
+        return None
+
+
+class ElectionSpec(Spec):
+    """Raft election safety as a sequential spec: at most one leader per
+    term.
+
+    Election histories are invoke-only (an ``OP_ELECT`` row per won
+    election, key = term, inp = winner node; no client observes a
+    completion), and the WGL search treats open ops as *optional* —
+    omittable — so the invariant lives entirely in ``structural``: two
+    OP_ELECT rows for one term naming different nodes is the breach. The
+    device raft model records these rows through its ``record`` hook and
+    the host example through ``HostRecorder``, which is what lets the
+    differential harness (explore/differential.py) check both tiers
+    against this one spec.
+    """
+
+    name = "election"
+
+    def init(self):
+        return ABSENT
+
+    def apply(self, state, op: Op):
+        # open ops carry no observation; the state tracks the term's
+        # winner for completeness but structural() is the real check
+        if op.op == OP_ELECT:
+            return True, op.inp
+        return False, state
+
+    def partition_of(self, op: Op) -> int:
+        return op.key  # the term
+
+    def structural(self, ops: Sequence[Op]) -> Optional[Tuple[int, str]]:
+        winner: Dict[int, int] = {}  # term -> node
+        for i, op in enumerate(ops):
+            if op.op != OP_ELECT:
+                continue
+            prev = winner.get(op.key)
+            if prev is not None and prev != op.inp:
+                return i, (
+                    f"two leaders elected in term {op.key}: node {prev} "
+                    f"and node {op.inp}"
+                )
+            winner[op.key] = op.inp
         return None

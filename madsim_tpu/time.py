@@ -182,7 +182,24 @@ class TimeHandle:
         )
         self._clock_ns = 0  # monotonic ns since sim start
         self._q = _make_timer_queue()
+        self._skew = {}  # node id -> (num, den) clock-skew ratio
         rng._now_ns = lambda: self._clock_ns
+
+    # -- per-node clock skew (gray failures, docs/faults.md) --------------
+    # The fault supervisor (madsim_tpu/faults.apply_schedule) registers a
+    # skew ratio while a victim's clock-skew window is open; ``sleep``
+    # stretches that node's relative waits by num/den, and user code that
+    # computes its own deadlines consults ``node_skew()``. The device
+    # tier's counterpart is ``engine.faults.skewed_delay``.
+
+    def set_node_skew(self, node_id, num: int, den: int) -> None:
+        self._skew[node_id] = (int(num), int(den))
+
+    def clear_node_skew(self, node_id) -> None:
+        self._skew.pop(node_id, None)
+
+    def node_skew_of(self, node_id) -> Tuple[int, int]:
+        return self._skew.get(node_id, (1, 1))
 
     # -- clocks -----------------------------------------------------------
 
@@ -286,6 +303,7 @@ class _NativeTimeHandle(TimeHandle):
         )
         self._core = core = _simloop.Timers()
         self._q = None  # the heap lives in the core
+        self._skew = {}  # node id -> (num, den) clock-skew ratio
         rng._now_ns = lambda: core.clock
 
     @property
@@ -398,7 +416,11 @@ _ns_cache: dict = {}  # duration float -> clamped ns (workloads reuse a few cons
 
 
 def sleep(seconds: float) -> Sleep:
-    """Sleep for a virtual duration (min 1 ms, tokio parity)."""
+    """Sleep for a virtual duration (min 1 ms, tokio parity).
+
+    While the calling task's node is inside a clock-skew window
+    (docs/faults.md gray failures), the wait stretches by the registered
+    num/den ratio — the node's slow clock measures the duration."""
     # hand-inlined ambient lookup + _to_ns: this is the hottest API call
     # in a typical workload (one per task loop iteration)
     h = getattr(_ctx_tls, "handle", None)
@@ -414,6 +436,12 @@ def sleep(seconds: float) -> Sleep:
         if len(_ns_cache) < 4096:
             _ns_cache[seconds] = ns
     t = h.time
+    if t._skew:  # empty dict outside skew windows: one falsy check
+        task = getattr(_ctx_tls, "task", None)
+        if task is not None:
+            f = t._skew.get(task.node.id)
+            if f is not None:
+                ns = ns * f[0] // f[1]
     core = getattr(t, "_core", None)
     if core is not None:
         return _simloop.Sleep(core, core.clock + ns)
@@ -571,6 +599,23 @@ def now() -> float:
 def elapsed() -> float:
     """Seconds of virtual time since the simulation started."""
     return current_handle().time.elapsed()
+
+
+def node_skew() -> "Tuple[int, int]":
+    """The current task's node clock-skew ratio ``(num, den)`` — ``(1,
+    1)`` outside a skew window. User code that computes its own
+    deadlines (rather than sleeping the full duration) applies this to
+    the duration, mirroring what ``sleep`` does automatically; see
+    ``examples/raft_host.py`` election deadlines."""
+    h = getattr(_ctx_tls, "handle", None)
+    if h is None:
+        current_handle()  # raises NoContextError
+    if not h.time._skew:
+        return (1, 1)
+    task = getattr(_ctx_tls, "task", None)
+    if task is None:
+        return (1, 1)
+    return h.time._skew.get(task.node.id, (1, 1))
 
 
 def advance(seconds: float) -> None:

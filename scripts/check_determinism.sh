@@ -101,6 +101,26 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     cat "$out/a.log" "$out/b.log" >&2 || true
     exit 1
   fi
+
+  # differential leg: the host<->device differential report
+  # (docs/faults.md gray failures) must be byte-identical across two
+  # processes — a small matched grid here; the full 200-seed tolerance
+  # gate runs as `make differential-smoke`. Tolerance verdicts on this
+  # tiny grid are not the point (|| true); only the report bytes are.
+  for r in da db; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/differential_demo.py \
+      --seeds 32 --sim-seconds 1.5 --specs 2 \
+      --report "$out/$r.json" >"$out/$r.log" 2>&1 || true
+  done
+  if [ -s "$out/da.json" ] && cmp -s "$out/da.json" "$out/db.json"; then
+    echo "determinism gate: OK (two differential runs, byte-identical reports)"
+  else
+    echo "determinism gate: FAILED — differential reports differ or are empty" >&2
+    diff "$out/da.json" "$out/db.json" >&2 || true
+    echo "--- differential_demo run logs ---" >&2
+    cat "$out/da.log" "$out/db.log" >&2 || true
+    exit 1
+  fi
 else
   echo "determinism gate: FAILED — traces differ between identical runs" >&2
   "${PY:-python}" - "$out/a.npz" "$out/b.npz" <<'EOF' >&2

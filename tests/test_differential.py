@@ -1,0 +1,141 @@
+"""Host↔device differential validation (madsim_tpu/explore/differential.py).
+
+The contract under test: one FaultSpec drives the device raft model and
+the host raft example over a matched (spec, seed) grid; outcome
+distributions agree within tolerances; BOTH tiers' recorded election
+histories check against ONE sequential spec (oracle.ElectionSpec) with
+a verdict that agrees exactly with each tier's own online violation
+latch; and the report is deterministic. The full 200-seed gate runs as
+`make differential-smoke` — these tests exercise the machinery on small
+grids.
+"""
+
+import json
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+
+sys.path.insert(0, __file__.rsplit("/", 2)[0] + "/examples")
+
+import raft_host
+
+from madsim_tpu import explore, replay
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.faults import FaultSpec
+from madsim_tpu.explore.differential import (
+    DifferentialConfig,
+    device_outcomes,
+    gate_specs,
+    host_outcomes,
+    run_differential,
+)
+from madsim_tpu.models import raft
+from madsim_tpu.oracle import ElectionSpec, check_history
+from madsim_tpu.oracle.history import OP_ELECT, PH_INVOKE, Op
+
+
+def _elect(client: int, term: int, node: int, at: int) -> Op:
+    return Op(
+        client=client, op=OP_ELECT, key=term, inp=node, out=0,
+        invoke_ns=at, complete_ns=-1, opid=term,
+    )
+
+
+def test_election_spec_structural():
+    """At most one leader per term — enforced structurally (election
+    rows are open ops, which the WGL search may omit)."""
+    from madsim_tpu.oracle.history import History
+
+    ok = History(seed=0, ops=(
+        _elect(0, 1, 0, 10), _elect(1, 2, 1, 20), _elect(0, 3, 0, 30),
+    ), overflow=False, rows=3)
+    assert check_history(ok, ElectionSpec()).ok
+    bad = History(seed=0, ops=(
+        _elect(0, 1, 0, 10), _elect(1, 1, 1, 20),
+    ), overflow=False, rows=2)
+    res = check_history(bad, ElectionSpec())
+    assert not res.ok and "two leaders" in res.reason
+    assert res.bad_index == 1
+
+
+def test_device_raft_history_agrees_with_online_latch():
+    """The device record hook: every lane's decoded election history is
+    rejected by ElectionSpec exactly when the online election-safety
+    latch fired (the amnesia sweep has both kinds of seeds)."""
+    base, _ = replay.amnesia_raft_config()
+    cfg = base._replace(hist_slots=64, history=64)
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    final = ecore.run_sweep(
+        raft.workload(cfg), ecfg, jnp.arange(96, dtype=jnp.int64)
+    )
+    violation = np.asarray(final.wstate.violation)
+    assert violation.any(), "amnesia sweep found no violations"
+    assert not violation.all()
+    from madsim_tpu.oracle import decode_sweep
+
+    spec = ElectionSpec()
+    for lane, hist in enumerate(decode_sweep(final)):
+        assert all(op.op == OP_ELECT for op in hist.ops)
+        assert len(hist.ops) == int(np.asarray(final.wstate.elections)[lane])
+        assert (not check_history(hist, spec).ok) == bool(violation[lane]), lane
+
+
+def test_host_raft_emits_checkable_history():
+    out = raft_host.run_seed(3, n=3, crashes=1, sim_seconds=1.5)
+    hist = out["history"]
+    assert len(hist.ops) == out["leaders_elected"] > 0
+    assert all(op.op == OP_ELECT and op.inp == op.client for op in hist.ops)
+    assert (not check_history(hist, ElectionSpec()).ok) == (
+        out["violations"] > 0
+    )
+
+
+def test_differential_grid_passes_and_is_deterministic(tmp_path):
+    """A small matched grid: the tolerance verdict holds, histories
+    agree with latches on both tiers, and two in-process runs emit
+    byte-identical reports."""
+    dcfg = DifferentialConfig(seeds=16, sim_seconds=1.5)
+    spec = gate_specs()[0]
+    a, b = tmp_path / "a.json", tmp_path / "b.json"
+    r1 = run_differential([spec], dcfg, report_path=str(a))
+    r2 = run_differential([spec], dcfg, report_path=str(b))
+    assert a.read_bytes() == b.read_bytes()
+    rec = r1["specs"][0]
+    assert rec["device"]["hist_mismatch_seeds"] == 0
+    assert rec["host"]["hist_mismatch_seeds"] == 0
+    assert rec["device"]["hist_overflow_seeds"] == 0
+    assert rec["device"]["elected_seeds"] + rec["device"]["no_leader_seeds"] == 16
+    assert r1["pass"] == rec["pass"]
+    # the report round-trips as canonical JSON
+    assert json.loads(a.read_text()) == r1
+
+
+def test_differential_outcomes_respond_to_the_fault_environment():
+    """Both tiers obey the one compiled schedule: a literal full-mesh
+    partition (FixedFaults — identical on both tiers for every seed)
+    suppresses elections while clogged. The device horizon ends before
+    the heal, so every seed stays leaderless; the host run extends one
+    second past the heal (run_seed_with_plan's observation window), so
+    it elects — but every recorded election lands AFTER the mesh
+    unclogs."""
+    from madsim_tpu.engine.faults import FixedFaults
+
+    heal_ns = 1_500_000_000
+    fixed = FixedFaults(events=(
+        (10_000_000, "partition", 0),
+        (10_000_001, "partition", 1),
+        (10_000_002, "partition", 2),
+        (heal_ns, "heal", 0),
+        (heal_ns + 1, "heal", 1),
+        (heal_ns + 2, "heal", 2),
+    ))
+    dcfg = DifferentialConfig(seeds=8, sim_seconds=1.0)
+    dev = device_outcomes(fixed, dcfg)
+    assert dev.no_leader_seeds == 8, dev
+    for seed in range(3):
+        out = raft_host.run_seed_with_spec(seed, fixed, seed, n=3, sim_seconds=1.0)
+        assert out["leaders_elected"] > 0
+        assert all(op.invoke_ns >= heal_ns for op in out["history"].ops)
+    assert explore.run_differential is run_differential  # package export
+    assert host_outcomes  # exercised by the grid test above

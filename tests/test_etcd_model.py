@@ -87,7 +87,8 @@ def test_lease_state_is_consistent_at_end():
     assert (mod_rev[p_s, p_k] >= 1).all()
     assert (mod_rev[p_s, p_k] <= rev[p_s]).all()
     # partition refcounts all returned to zero (every window healed)
-    assert (np.asarray(w.fstate.part_cnt) == 0).all()
+    assert (np.asarray(w.fstate.part_in_cnt) == 0).all()
+    assert (np.asarray(w.fstate.part_out_cnt) == 0).all()
 
 
 def test_traced_replay_matches_sweep():

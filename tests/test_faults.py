@@ -141,7 +141,7 @@ def test_partition_refcount_composes():
     assert bool(links.clog[1, 0]), "still inside the second window"
     links, f = _apply(FULL_SPEC, base, links, f, efaults.F_HEAL, 1)
     assert not bool(links.clog.any())
-    assert int(f.part_cnt[1]) == 0
+    assert int(f.part_in_cnt[1]) == 0 and int(f.part_out_cnt[1]) == 0
 
 
 def test_burst_overrides_and_restores_base_values():
@@ -372,6 +372,293 @@ def test_host_supervisor_applies_partitions_and_bursts():
     assert not observed["clogged_end"]
     assert observed["lat_end"] == observed["base_latency"]
     assert observed["loss_end"] == 0.0
+
+
+# -- gray failures: asymmetric partitions, slow disks, power fail, skew ------
+
+# every gray family enabled, windows inside the sim horizon
+GRAY_SPEC = efaults.FaultSpec(
+    crashes=1,
+    crash_window_ns=1_000_000_000,
+    restart_lo_ns=100_000_000,
+    restart_hi_ns=400_000_000,
+    aparts=2,
+    apart_window_ns=1_200_000_000,
+    apart_lo_ns=200_000_000,
+    apart_hi_ns=600_000_000,
+    fsync_stalls=1,
+    fsync_window_ns=1_200_000_000,
+    fsync_lo_ns=300_000_000,
+    fsync_hi_ns=800_000_000,
+    power_fails=1,
+    power_window_ns=1_500_000_000,
+    power_lo_ns=50_000_000,
+    power_hi_ns=300_000_000,
+    skews=1,
+    skew_window_ns=1_200_000_000,
+    skew_lo_ns=300_000_000,
+    skew_hi_ns=800_000_000,
+)
+
+
+def test_gray_schedule_identical_on_both_tiers():
+    """The gray grammar compiles to the identical (time, action, victim)
+    schedule on both tiers — through the device engine's queue and
+    dispatch, exactly like the clean families."""
+    cfg = raft.RaftConfig(num_nodes=4, commands=0, faults=GRAY_SPEC)
+    ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000, max_steps=30_000)
+    wl = raft.workload(cfg)
+    for seed in (0, 11):
+        _, trace = ecore.run_traced(wl, ecfg, seed)
+        device = replay.extract_fault_schedule(trace, raft.K_FAULT)
+        host = hfaults.compile_host(GRAY_SPEC, cfg.num_nodes, seed)
+        assert device == host, (seed, device, host)
+        assert len(device) == efaults.num_events(GRAY_SPEC)
+
+
+def test_gray_schedule_families_windows_and_directions():
+    dirs = set()
+    for seed in range(16):
+        sched = hfaults.compile_host(GRAY_SPEC, 4, seed)
+        acts = {}
+        for t, a, v in sched:
+            acts.setdefault(a, []).append((t, v))
+        # the asymmetric category draws a direction per window
+        n_apart = sum(len(acts.get(a, [])) for a in ("part_in", "part_out"))
+        assert n_apart == GRAY_SPEC.aparts
+        dirs.update(a for a in ("part_in", "part_out") if a in acts)
+        # every heal matches its window's direction and victim
+        for on, off in (("part_in", "heal_in"), ("part_out", "heal_out")):
+            assert sorted(v for _, v in acts.get(on, [])) == sorted(
+                v for _, v in acts.get(off, [])
+            )
+            for t, _ in acts.get(on, []):
+                assert 0 <= t < GRAY_SPEC.apart_window_ns
+        assert len(acts["fsync_stall"]) == len(acts["fsync_ok"]) == 1
+        assert len(acts["power_fail"]) == 1
+        # power fail's off action IS restart (shared with crash storms)
+        assert len(acts["restart"]) == GRAY_SPEC.crashes + GRAY_SPEC.power_fails
+        assert len(acts["skew_on"]) == len(acts["skew_off"]) == 1
+    assert dirs == {"part_in", "part_out"}, "both directions must occur"
+
+
+def test_asymmetric_partition_clogs_one_direction():
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART_IN, 1)
+    assert bool(links.clog[0, 1]) and bool(links.clog[2, 1]), "inbound clogged"
+    assert not bool(links.clog[1, 0]) and not bool(links.clog[1, 2]), (
+        "outbound must stay open"
+    )
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL_IN, 1)
+    assert not bool(links.clog.any())
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART_OUT, 1)
+    assert bool(links.clog[1, 0]) and not bool(links.clog[0, 1])
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL_OUT, 1)
+    assert not bool(links.clog.any())
+
+
+def test_overlapping_symmetric_and_asymmetric_partitions():
+    """The satellite-6 regression: a symmetric heal must not un-clog a
+    direction an overlapping asymmetric window still holds — neither on
+    the same victim nor on a link cell shared with another victim."""
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    # same victim: partition(1) + part_in(1), then heal(1)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART, 1)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART_IN, 1)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL, 1)
+    assert bool(links.clog[0, 1]), "inbound still held by the asym window"
+    assert not bool(links.clog[1, 0]), "outbound healed"
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL_IN, 1)
+    assert not bool(links.clog.any())
+    # different victims sharing a cell: node 0's out-clog holds [0, 1]
+    # across node 1's symmetric heal
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART_OUT, 0)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_PART, 1)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL, 1)
+    assert bool(links.clog[0, 1]), "cell still held by node 0's out window"
+    assert not bool(links.clog[2, 1]) and not bool(links.clog[1, 2])
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_HEAL_OUT, 0)
+    assert not bool(links.clog.any())
+    assert int(f.part_in_cnt.sum()) == 0 and int(f.part_out_cnt.sum()) == 0
+
+
+def test_fsync_and_skew_refcounts_compose():
+    base = efaults.NetBase(1_000_000, 10_000_000, 0)
+    links = enet.make(3)
+    f = efaults.init_state(3)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_FSYNC_STALL, 2)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_FSYNC_STALL, 2)
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_FSYNC_OK, 2)
+    assert bool(efaults.stalled(f)[2]), "still inside the second window"
+    links, f = _apply(GRAY_SPEC, base, links, f, efaults.F_FSYNC_OK, 2)
+    assert not bool(efaults.stalled(f).any())
+    spec = GRAY_SPEC._replace(skew_num=2, skew_den=1)
+    links, f = _apply(spec, base, links, f, efaults.F_SKEW_ON, 0)
+    assert int(efaults.skewed_delay(spec, f, 0, 100)) == 200
+    assert int(efaults.skewed_delay(spec, f, 1, 100)) == 100, "other nodes unskewed"
+    links, f = _apply(spec, base, links, f, efaults.F_SKEW_OFF, 0)
+    assert int(efaults.skewed_delay(spec, f, 0, 100)) == 100
+
+
+def test_power_fail_drops_unsynced_raft_writes():
+    """The device durability plane: a log entry appended while the
+    node's disk is stalled is NOT durable — power fail (or crash) rolls
+    the log back to the synced frontier; the same append on an
+    unstalled node survives its crash."""
+    # a spec WITH a stall window: the durability shadow is statically
+    # gated on the spec (raft._shadow_nodes) — stall-free specs allocate
+    # no shadow and keep the pre-gray crash semantics for free
+    spec = efaults.FaultSpec(fsync_stalls=1)
+    cfg = raft.RaftConfig(num_nodes=3, commands=0, faults=spec)
+    wl = raft.workload(cfg)
+    w, _ = wl.init(jax.random.key(0))
+    w = w._replace(
+        role=w.role.at[0].set(2).at[1].set(2),  # both nodes LEADER
+        fstate=w.fstate._replace(fsync_cnt=w.fstate.fsync_cnt.at[0].set(1)),
+    )
+    rand = jnp.zeros((wl.num_rand,), jnp.uint32)
+
+    def cmd(w, target):
+        pay = jnp.zeros((wl.payload_slots,), jnp.int32).at[0].set(target)
+        w2, _ = wl.handle(w, jnp.int64(1_000), jnp.int32(raft.K_CMD), pay, rand)
+        return w2
+
+    def fault(w, action, victim):
+        pay = (
+            jnp.zeros((wl.payload_slots,), jnp.int32)
+            .at[0].set(action)
+            .at[1].set(victim)
+        )
+        w2, _ = wl.handle(w, jnp.int64(2_000), jnp.int32(raft.K_FAULT), pay, rand)
+        return w2
+
+    w = cmd(cmd(w, 0), 1)  # one entry appended on each leader
+    assert int(w.log_len[0]) == 1 and int(w.log_len[1]) == 1
+    assert int(w.dur_log_len[0]) == 0, "stalled node: append not durable"
+    assert int(w.dur_log_len[1]) == 1, "unstalled node synced immediately"
+    w = fault(w, efaults.F_POWER_FAIL, 0)
+    w = fault(w, efaults.F_CRASH, 1)
+    assert int(w.log_len[0]) == 0, "unsynced entry dropped on power fail"
+    assert int(w.log_len[1]) == 1, "synced entry survives the crash"
+    # the disk catches up when the window closes: later appends persist
+    w = fault(w, efaults.F_RESTART, 0)
+    w = fault(w, efaults.F_FSYNC_OK, 0)
+    w = cmd(w._replace(role=w.role.at[0].set(2)), 0)
+    assert int(w.dur_log_len[0]) == 1
+    w = fault(w, efaults.F_CRASH, 0)
+    assert int(w.log_len[0]) == 1
+    # stall-free specs allocate no shadow planes at all (static gating)
+    plain = raft.workload(raft.RaftConfig(num_nodes=3, commands=0))
+    w0, _ = plain.init(jax.random.key(0))
+    assert w0.dur_term.shape == (0,)
+    assert w0.dur_log_term.shape == (0, cfg.log_cap)
+
+
+def test_skewed_node_arms_stretched_timers():
+    """Clock skew on the device tier: a skewed victim's revival timer
+    arms at the stretched deadline (timer arming runs on the node's own
+    slow clock). The spec must draw skew windows — skew-free specs gate
+    ``skewed_delay`` off statically (``efaults.can_skew``)."""
+    spec = efaults.FaultSpec(skews=1, skew_num=2, skew_den=1)
+    cfg = raft.RaftConfig(num_nodes=3, commands=0, faults=spec)
+    wl = raft.workload(cfg)
+    w, _ = wl.init(jax.random.key(0))
+    rand = jnp.zeros((wl.num_rand,), jnp.uint32)  # bounded(0, lo, hi) == lo
+    pay = jnp.zeros((wl.payload_slots,), jnp.int32)
+    pay = pay.at[0].set(efaults.F_RESUME)  # victim 0
+    now = 5_000
+    for skewed in (False, True):
+        w0 = w._replace(
+            fstate=w.fstate._replace(
+                paused=w.fstate.paused.at[0].set(True),
+                skew_cnt=w.fstate.skew_cnt.at[0].set(1 if skewed else 0),
+            )
+        )
+        _, emits = wl.handle(w0, jnp.int64(now), jnp.int32(raft.K_FAULT), pay, rand)
+        times = {
+            int(t)
+            for t, k, en in zip(
+                np.asarray(emits.times), np.asarray(emits.kinds),
+                np.asarray(emits.enables),
+            )
+            if en and k == raft.K_ELECTION
+        }
+        factor = 2 if skewed else 1
+        assert times == {now + factor * cfg.election_lo_ns}, (skewed, times)
+
+
+def test_host_supervisor_applies_gray_actions():
+    """apply_schedule drives the directional NetSim clogs and the
+    TimeHandle skew registry with the same refcount semantics as the
+    device interpreter."""
+    from madsim_tpu.net import NetSim
+    from madsim_tpu.runtime import _node_id
+
+    schedule = [
+        (100_000_000, "part_in", 1),
+        (150_000_000, "partition", 1),
+        (200_000_000, "skew_on", 0),
+        (300_000_000, "heal", 1),  # out heals; in still held by part_in
+        (400_000_000, "heal_in", 1),
+        (500_000_000, "skew_off", 0),
+    ]
+    observed = {}
+
+    async def main():
+        h = ms.current_handle()
+        ns = h.simulator(NetSim)
+        nodes = [h.create_node().name(f"n{i}").build() for i in range(2)]
+
+        async def probe():
+            await ms.sleep(0.25)  # inside part_in + partition + skew
+            observed["in_mid"] = ns.network.is_clogged(nodes[0].id, nodes[1].id)
+            observed["out_mid"] = ns.network.is_clogged(nodes[1].id, nodes[0].id)
+            observed["skew_mid"] = h.time.node_skew_of(_node_id(nodes[0]))
+            await ms.sleep(0.1)  # after the symmetric heal
+            observed["in_after_heal"] = ns.network.is_clogged(
+                nodes[0].id, nodes[1].id
+            )
+            observed["out_after_heal"] = ns.network.is_clogged(
+                nodes[1].id, nodes[0].id
+            )
+
+        ms.spawn(probe())
+        await hfaults.apply_schedule(schedule, nodes, spec=GRAY_SPEC)
+        observed["in_end"] = ns.network.is_clogged(nodes[0].id, nodes[1].id)
+        observed["skew_end"] = h.time.node_skew_of(_node_id(nodes[0]))
+
+    ms.Runtime(seed=1).block_on(main())
+    assert observed["in_mid"] and observed["out_mid"]
+    assert observed["skew_mid"] == (GRAY_SPEC.skew_num, GRAY_SPEC.skew_den)
+    assert observed["in_after_heal"], "heal must not un-clog the asym window"
+    assert not observed["out_after_heal"]
+    assert not observed["in_end"]
+    assert observed["skew_end"] == (1, 1)
+
+
+def test_gray_campaign_sweep_is_deterministic_and_perturbs():
+    """A full gray campaign through the sweep engine: replay parity
+    holds and the gray faults demonstrably perturb schedules."""
+    base_cfg = raft.RaftConfig(num_nodes=4, commands=4, crashes=0)
+    cfg = base_cfg._replace(faults=GRAY_SPEC)
+    ecfg = raft.engine_config(
+        cfg, queue_capacity=160, time_limit_ns=3_000_000_000, max_steps=30_000
+    )
+    seeds = jnp.arange(48, dtype=jnp.int64)
+    quiet = ecore.run_sweep(
+        raft.workload(base_cfg._replace(faults=efaults.FaultSpec())), ecfg, seeds
+    )
+    gray = ecore.run_sweep(raft.workload(cfg), ecfg, seeds)
+    s = raft.sweep_summary(gray)
+    assert s["overflow_seeds"] == 0
+    frac_changed = np.mean(np.asarray(quiet.ctr) != np.asarray(gray.ctr))
+    assert frac_changed > 0.5, frac_changed
+    single, _ = ecore.run_traced(raft.workload(cfg), ecfg, 17)
+    assert int(single.ctr) == int(gray.ctr[17])
 
 
 def test_etcd_campaign_server_crash_gates_processing():

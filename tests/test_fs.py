@@ -226,3 +226,151 @@ def test_durable_remove_survives_power_fail():
         await node.spawn(phase2())
 
     rt.block_on(main())
+
+
+# -- slow-disk windows + schedule-driven power fail (gray failures) ----------
+
+
+def test_fsync_stall_defers_durability():
+    """Inside a slow-disk window sync_all returns but nothing becomes
+    durable: a power fail drops the 'synced' data; closing the window
+    applies the pending sync."""
+    rt = ms.Runtime(seed=11)
+
+    async def main():
+        h = ms.current_handle()
+        fssim = h.simulator(fs.FsSim)
+        node = h.create_node().name("graydisk").build()
+
+        async def phase1():
+            await fs.write("/wal", b"durable")
+
+        await node.spawn(phase1())
+        fssim.stall_fsync(node.id)
+
+        async def phase2():
+            f = await fs.File.open("/wal")
+            await f.write_all(b"+lied")
+            await f.sync_all()  # the disk lies: defers
+            assert await f.read_all() == b"durable+lied"
+
+        await node.spawn(phase2())
+        fssim.power_fail(node.id)
+
+        async def phase3():
+            assert await fs.read("/wal") == b"durable"
+            f = await fs.File.open("/wal")
+            await f.write_all(b"+caught")
+            await f.sync_all()  # still stalled: defers again
+
+        await node.spawn(phase3())
+        fssim.unstall_fsync(node.id)  # the disk catches up
+        fssim.power_fail(node.id)
+
+        async def phase4():
+            assert await fs.read("/wal") == b"durable+caught"
+
+        await node.spawn(phase4())
+
+    rt.block_on(main())
+
+
+def test_fault_schedule_drives_power_fail_machinery():
+    """Satellite acceptance: a LITERAL fault schedule (FixedFaults wire
+    format — identical on both tiers for any seed, tests/test_faults.py)
+    drives fsync_stall -> power_fail -> restart -> fsync_ok through
+    apply_schedule, and the node's storage shows exactly the power-fail
+    semantics: unsynced writes dropped, never-synced files vanished,
+    unsynced removals resurrected."""
+    from madsim_tpu import faults as hfaults
+    from madsim_tpu.engine import faults as efaults
+
+    fixed = efaults.FixedFaults(
+        events=(
+            (200_000_000, "fsync_stall", 0),
+            (500_000_000, "power_fail", 0),
+            (700_000_000, "restart", 0),
+            (900_000_000, "fsync_ok", 0),
+        )
+    )
+    # the literal compiles seed-independently and identically on both
+    # tiers; the device half of these semantics is the raft durability
+    # plane (tests/test_faults.py::test_power_fail_drops_unsynced_raft_writes)
+    assert hfaults.compile_host(fixed, 1, 3) == sorted(
+        (t, a, v) for t, a, v in fixed.events
+    )
+    rt = ms.Runtime(seed=12)
+    observed = {}
+
+    async def main():
+        h = ms.current_handle()
+        node = h.create_node().name("victim").build()
+
+        async def workload():
+            # before the stall: one durable file, one durably-removed
+            await fs.write("/keep", b"base")
+            await fs.write("/zombie", b"boo")
+            await ms.sleep(0.3)  # now inside the stall window
+            f = await fs.File.open("/keep")
+            await f.write_all(b"+lost")
+            await f.sync_all()  # deferred: will be dropped
+            await fs.write("/fresh", b"never-durable")
+            await fs.remove_file("/zombie")  # unsynced removal
+            # the power fail at 0.5 s kills this task with the node
+
+        node.spawn(workload())  # runs concurrently with the supervisor
+        await hfaults.apply_schedule(
+            [(t, a, v) for t, a, v in fixed.events], [node]
+        )
+
+        async def inspect():
+            observed["keep"] = await fs.read("/keep")
+            observed["zombie"] = await fs.read("/zombie")
+            try:
+                await fs.read("/fresh")
+                observed["fresh_gone"] = False
+            except FileNotFoundError:
+                observed["fresh_gone"] = True
+
+        await node.spawn(inspect())
+
+    rt.block_on(main())
+    assert observed["keep"] == b"base", "unsynced write dropped"
+    assert observed["zombie"] == b"boo", "unsynced removal resurrected"
+    assert observed["fresh_gone"], "never-synced file vanished"
+
+
+def test_recreate_supersedes_deferred_durable_unlink():
+    """A durable unlink deferred by a stall window must NOT outlive a
+    re-creation of the path: create + sync after the deferred removal,
+    and the window's close keeps the new file (regression: the stale
+    remove_requested flag used to delete it at unstall)."""
+    rt = ms.Runtime(seed=13)
+
+    async def main():
+        h = ms.current_handle()
+        fssim = h.simulator(fs.FsSim)
+        node = h.create_node().build()
+
+        async def phase1():
+            await fs.write("/x", b"old")
+
+        await node.spawn(phase1())
+        fssim.stall_fsync(node.id)
+
+        async def phase2():
+            await fs.remove_file("/x", durable=True)  # deferred unlink
+            f = await fs.File.create("/x")  # re-creation supersedes it
+            await f.write_all(b"new")
+            await f.sync_all()  # deferred data sync
+
+        await node.spawn(phase2())
+        fssim.unstall_fsync(node.id)
+        fssim.power_fail(node.id)
+
+        async def phase3():
+            assert await fs.read("/x") == b"new"
+
+        await node.spawn(phase3())
+
+    rt.block_on(main())
