@@ -21,7 +21,9 @@
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
-#                      plus two history decodes, bytes diffed)
+#                      plus two history decodes, bytes diffed; plus the
+#                      pipelined checked-sweep report across two
+#                      processes x two worker-pool sizes, byte-diffed)
 #                      + explore-smoke + oracle-smoke
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality

@@ -54,7 +54,11 @@ SIM_SECONDS = 3.0
 # compiled executor core runs >100 seeds/s (was 8 when it ran at ~37/s —
 # flagged as too thin for the vs_baseline denominator)
 HOST_SEEDS = 48
-CURVE = (4096, 16384, 65536)
+# 32,768 brackets the occupancy knee: r05 measured 45.1k seeds/s at
+# 16,384 and 33.9k at 65,536 with nothing in between, so the cliff's
+# location was a guess; each point now also reports its loop-carry HBM
+# footprint (core.state_bytes_per_seed) so the knee is attributable
+CURVE = (4096, 16384, 32768, 65536)
 # 131,072 seeds — the "100k-seed" artifact — as 16k chunks of one
 # compiled program: per-lane step cost cliffs ~9x above ~16k seeds
 # (see core.run_sweep_chunked), so chunking IS the fast path
@@ -65,6 +69,17 @@ BIG_CHUNK = 16384
 REPS = 3
 # seed-batch size for the recovery and cross-backend parity phases
 PARITY_SEEDS = 4096
+# checked-sweep leg (sweep + on-device screen + WGL check, end to end):
+# the etcd history workload at 131k seeds through the pipelined driver,
+# vs a naive decode-and-check-every-seed loop measured in the same run
+CHECKED_TOTAL = 131072
+CHECKED_CHUNK = None  # None = auto-pick the occupancy knee
+CHECKED_SIM_SECONDS = 2.0  # hist_slots=256 is sized for a 2 s horizon
+NAIVE_SEEDS = 4096
+CHECK_WORKERS = 8
+# pipelined-recovery leg: 2 chunks, interrupted mid-chunk-0
+PIPE_SEEDS = 2048
+PIPE_CHUNK = 1024
 
 _seed_cursor = [1]
 
@@ -104,9 +119,12 @@ def bench_host() -> dict:
 def bench_curve(wl, ecfg, raft):
     """seeds/sec at each batch size: REPS interleaved timed runs per size
     (rep-outer, size-inner, so a drift window hits every size equally),
-    min taken per size; compile time split out per size."""
+    min taken per size; compile time split out per size. Each point
+    carries its loop-carry HBM footprint so the occupancy knee
+    (ROADMAP item 3) is attributable to a measured byte count."""
     from madsim_tpu.engine import core
 
+    per_seed = core.state_bytes_per_seed(wl, ecfg)
     compile_s = {}
     summaries = {}
     for s in CURVE:
@@ -143,6 +161,7 @@ def bench_curve(wl, ecfg, raft):
                 "reps": REPS,
                 "spread": _spread(times[s]),
                 "violations": summary["violations"],
+                "hbm_bytes": s * per_seed,
             }
         )
     return curve
@@ -214,6 +233,120 @@ def bench_recovery(wl, raft_mod):
     )
     return {"seeds": PARITY_SEEDS, "interrupted_at_step": 300,
             "bit_identical": identical}
+
+
+def bench_checked_sweep() -> dict:
+    """END-TO-END checked throughput — the quantity this round makes
+    the optimized one: seeds/s through sweep PLUS history validation.
+
+    The pipelined leg runs the etcd history workload (clean config,
+    hist_slots=256) at CHECKED_TOTAL seeds through
+    ``oracle.screen.checked_sweep``: chunked sweep, on-device suspect
+    screen folded behind each chunk, host-side decode + process-pool
+    WGL checking of chunk N overlapped with the device sweep of chunk
+    N+1. The naive baseline — sweep, decode EVERY lane, check serially,
+    no overlap — is measured in the same run (on a smaller seed count;
+    rates compare directly since both are per-seed-linear)."""
+    from madsim_tpu.engine import core
+    from madsim_tpu.models import etcd
+    from madsim_tpu.oracle import check_histories, decode_sweep
+    from madsim_tpu.oracle.screen import checked_sweep
+
+    cfg = etcd.EtcdConfig(hist_slots=256)
+    ecfg = etcd.engine_config(
+        cfg, time_limit_ns=int(CHECKED_SIM_SECONDS * 1e9)
+    )
+    wl = etcd.workload(cfg)
+    spec = etcd.history_spec()
+    chunk = CHECKED_CHUNK or core.pick_chunk_size(wl, ecfg)
+    total = max(CHECKED_TOTAL, 2 * chunk)
+
+    # warm every program untimed — BOTH legs: the pipeline's sweep/
+    # screen/summary/pool at the chunk shape, AND the naive leg's sweep
+    # at NAIVE_SEEDS (a compile inside nwall would hand the pipeline a
+    # fake speedup) plus one decode+check rep
+    checked_sweep(
+        wl, ecfg, _fresh(chunk), spec, etcd.sweep_summary,
+        chunk_size=chunk, workers=CHECK_WORKERS,
+    )
+    warm_naive = core.run_sweep(wl, ecfg, _fresh(NAIVE_SEEDS))
+    check_histories(decode_sweep(warm_naive), spec)
+
+    t0 = walltime.perf_counter()
+    totals = checked_sweep(
+        wl, ecfg, _fresh(total), spec, etcd.sweep_summary,
+        chunk_size=chunk, workers=CHECK_WORKERS,
+    )
+    wall = walltime.perf_counter() - t0
+
+    t0 = walltime.perf_counter()
+    nfinal = core.run_sweep(wl, ecfg, _fresh(NAIVE_SEEDS))
+    hists = decode_sweep(nfinal)
+    naive_bad = sum(
+        1 for r in check_histories(hists, spec) if not r.ok
+    )
+    nwall = walltime.perf_counter() - t0
+
+    rate, nrate = total / wall, NAIVE_SEEDS / nwall
+    return {
+        "seeds": total,
+        "chunk_size": chunk,
+        "workers": CHECK_WORKERS,
+        "wall_s": round(wall, 2),
+        "seeds_per_sec": round(rate, 1),
+        "suspects": totals["hist_suspects"],
+        "hist_violations": totals["hist_violations"],
+        "hist_overflow_seeds": totals["hist_overflow_seeds"],
+        "naive": {
+            "seeds": NAIVE_SEEDS,
+            "wall_s": round(nwall, 2),
+            "seeds_per_sec": round(nrate, 1),
+            "hist_violations": naive_bad,
+        },
+        "speedup_vs_naive": round(rate / nrate, 1),
+    }
+
+
+def bench_recovery_pipelined() -> dict:
+    """The pipelined half of config #5's determinism story: interrupt a
+    checked sweep MID-CHUNK, checkpoint the in-flight chunk state with
+    its chunk metadata (format v7 ``inflight``), restore, resume with
+    overlap enabled — the merged checked-sweep report must be
+    bit-identical to the uninterrupted pipelined run."""
+    from madsim_tpu.engine import checkpoint, core
+    from madsim_tpu.models import etcd
+    from madsim_tpu.oracle.screen import checked_sweep
+
+    cfg = etcd.EtcdConfig(hist_slots=256)
+    full = etcd.engine_config(
+        cfg, time_limit_ns=int(CHECKED_SIM_SECONDS * 1e9)
+    )
+    short = etcd.engine_config(
+        cfg, time_limit_ns=int(CHECKED_SIM_SECONDS * 1e9), max_steps=300
+    )
+    wl = etcd.workload(cfg)
+    spec = etcd.history_spec()
+    seeds = _fresh(PIPE_SEEDS)
+    straight = checked_sweep(
+        wl, full, seeds, spec, etcd.sweep_summary, chunk_size=PIPE_CHUNK
+    )
+    partial = core.run_sweep(wl, short, seeds[:PIPE_CHUNK])
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "mid.npz")
+        checkpoint.save_sweep(
+            partial, path, inflight={"lo": 0, "k": PIPE_CHUNK}
+        )
+        restored = checkpoint.load_sweep(path, like=partial)
+        inflight = checkpoint.load_inflight(path)
+    resumed = checked_sweep(
+        wl, full, seeds, spec, etcd.sweep_summary, chunk_size=PIPE_CHUNK,
+        resume_from=(restored, inflight),
+    )
+    return {
+        "pipelined_seeds": PIPE_SEEDS,
+        "pipelined_interrupted_at_step": 300,
+        "pipelined_bit_identical": resumed == straight,
+    }
 
 
 def _leaf_np(a):
@@ -343,8 +476,10 @@ def main() -> None:
     curve = bench_curve(wl, ecfg, raft)
     big = bench_100k(wl, ecfg, raft)
     recovery = bench_recovery(wl, raft)
+    recovery.update(bench_recovery_pipelined())
     cross = bench_cross_backend(wl, ecfg)
     kafka_line, etcd_line = bench_secondary_models()
+    checked = bench_checked_sweep()
 
     # HEADLINE = the chunked 131k sweep: the production pattern, and —
     # at ~3 s of device work per rep — the only number the tunneled
@@ -386,7 +521,14 @@ def main() -> None:
                 },
                 "events_per_sec": big["events_per_sec"],
                 "batch_curve": curve,
+                "auto_chunk": {
+                    "chunk_size": core.pick_chunk_size(wl, ecfg),
+                    "state_bytes_per_seed": core.state_bytes_per_seed(
+                        wl, ecfg
+                    ),
+                },
                 "sweep_100k": big,
+                "checked_sweep": checked,
                 "recovery_e2e": recovery,
                 "cross_backend": cross,
                 "kafka": kafka_line,
@@ -403,7 +545,8 @@ def _smoke() -> None:
     — the CI/Make smoke target. Numbers are meaningless; the exit code
     and the JSON shape are the point."""
     global CURVE, BIG_TOTAL, BIG_CHUNK, HOST_SEEDS, REPS, SIM_SECONDS
-    global PARITY_SEEDS
+    global PARITY_SEEDS, CHECKED_TOTAL, CHECKED_CHUNK, CHECKED_SIM_SECONDS
+    global NAIVE_SEEDS, CHECK_WORKERS, PIPE_SEEDS, PIPE_CHUNK
     CURVE = (64, 128)
     BIG_TOTAL = 256
     BIG_CHUNK = 128
@@ -411,6 +554,13 @@ def _smoke() -> None:
     REPS = 2
     SIM_SECONDS = 0.5
     PARITY_SEEDS = 256
+    CHECKED_TOTAL = 256
+    CHECKED_CHUNK = 128
+    CHECKED_SIM_SECONDS = 0.5
+    NAIVE_SEEDS = 64
+    CHECK_WORKERS = 2
+    PIPE_SEEDS = 128
+    PIPE_CHUNK = 64
 
 
 if __name__ == "__main__":

@@ -11,6 +11,8 @@ preemption and failed seeds to be re-examined from mid-run state.
 
 from __future__ import annotations
 
+from typing import Callable, Optional, Tuple
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -29,11 +31,30 @@ from .core import EngineConfig, EngineState, Workload
 #     per-direction refcounts and gained ``fsync_cnt``/``skew_cnt``, and
 #     the raft model grew its durability shadows, so v5 files would load
 #     positionally misaligned.
-_FORMAT_VERSION = 6
+# v7: pipelined checked sweeps — a snapshot may carry ``__inflight__``
+#     chunk metadata (which chunk of a pipelined sweep the state belongs
+#     to, plus host-phase progress), so interrupt/resume of an
+#     overlapped sweep+check pipeline stays bit-identical. v6 readers
+#     would silently drop it and resume the state as a whole-sweep
+#     snapshot, double-counting completed chunks — which is why v6
+#     REJECTS v7, while this reader still ACCEPTS v6 files (the leaf
+#     layout is unchanged; an old snapshot simply has no inflight tag).
+_FORMAT_VERSION = 7
+_READABLE_VERSIONS = (6, 7)
 
 
-def save_sweep(state: EngineState, path: str) -> None:
-    """Serialize a batched EngineState to ``path`` (.npz)."""
+def save_sweep(
+    state: EngineState, path: str, inflight: Optional[dict] = None
+) -> None:
+    """Serialize a batched EngineState to ``path`` (.npz).
+
+    ``inflight`` (JSON-able dict, format v7) tags the snapshot as the
+    IN-FLIGHT CHUNK of a pipelined sweep — at least ``{"lo": <chunk
+    start index>, "k": <real lanes>}`` — so ``run_sweep_pipelined``
+    can resume mid-chunk (``resume_from``) instead of restarting the
+    chunk; read it back with ``load_inflight``."""
+    import json
+
     leaves, treedef = jax.tree.flatten(state)
     arrays = {}
     for i, leaf in enumerate(leaves):
@@ -42,7 +63,21 @@ def save_sweep(state: EngineState, path: str) -> None:
             arrays[f"leaf_{i}__key"] = np.asarray(jax.random.key_data(leaf))
         else:
             arrays[f"leaf_{i}"] = np.asarray(leaf)
+    if inflight is not None:
+        arrays["__inflight__"] = np.frombuffer(
+            json.dumps(inflight, sort_keys=True).encode(), dtype=np.uint8
+        )
     np.savez_compressed(path, __version__=_FORMAT_VERSION, **arrays)
+
+
+def load_inflight(path: str) -> Optional[dict]:
+    """The ``inflight`` chunk metadata of a v7 snapshot, or None."""
+    import json
+
+    data = np.load(path)
+    if "__inflight__" not in data:
+        return None
+    return json.loads(bytes(bytearray(data["__inflight__"])).decode())
 
 
 def load_sweep(path: str, like: EngineState) -> EngineState:
@@ -50,12 +85,12 @@ def load_sweep(path: str, like: EngineState) -> EngineState:
     it with ``init_sweep`` on any seed vector of the same shape/config)."""
     data = np.load(path)
     found = int(data["__version__"])
-    if found != _FORMAT_VERSION:
+    if found not in _READABLE_VERSIONS:
         raise ValueError(
             f"checkpoint format version mismatch: {path} is v{found}, "
-            f"this engine reads v{_FORMAT_VERSION} (the draw layout / state "
-            "schema changed between versions; re-run the sweep to produce a "
-            "fresh checkpoint)"
+            f"this engine reads v{_READABLE_VERSIONS} (the draw layout / "
+            "state schema changed between versions; re-run the sweep to "
+            "produce a fresh checkpoint)"
         )
     leaves, treedef = jax.tree.flatten(like)
     out = []
@@ -74,6 +109,68 @@ def resume_sweep(
     from .core import _drive
 
     return _drive(workload, cfg, state)  # shares run_sweep's trace cache
+
+
+def _chunk_sha(seeds_host: np.ndarray, lo: int, k: int) -> str:
+    """Identity of one chunk's full seed slice — endpoints alone can
+    collide across different seed vectors ([0,5,9] vs [0,7,9])."""
+    import hashlib
+
+    return hashlib.sha256(
+        np.ascontiguousarray(seeds_host[lo : lo + k]).tobytes()
+    ).hexdigest()
+
+
+def _load_chunk_summary(
+    path: str, first: int, last: int, sha: str, fp: str
+) -> dict:
+    """Validate a per-chunk checkpoint file against this sweep's
+    identity and return its summary — shared by both chunk drivers so
+    the guard protocol cannot fork between them. Records from before
+    the sha was added lack the key; their endpoint+fingerprint check
+    still applies (legacy-compatible)."""
+    import json
+
+    with open(path) as f:
+        rec = json.load(f)
+    if (
+        rec["first_seed"] != first
+        or rec["last_seed"] != last
+        or rec.get("seeds_sha256", sha) != sha
+        or rec.get("fingerprint") != fp
+    ):
+        raise ValueError(
+            f"checkpoint {path} is from a different sweep: holds "
+            f"seeds [{rec['first_seed']}, {rec['last_seed']}] "
+            f"(sha {rec.get('seeds_sha256')!r}) with "
+            f"fingerprint {rec.get('fingerprint')!r}, expected "
+            f"[{first}, {last}] (sha {sha!r}) with {fp!r}"
+        )
+    return rec["summary"]
+
+
+def _write_chunk_summary(
+    path: str, first: int, last: int, sha: str, fp: str, summary: dict
+) -> None:
+    """Atomically write one chunk's checkpoint record (tmp + rename: a
+    crash never leaves half a file) — shared by both chunk drivers."""
+    import json
+    import os
+
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(
+            {
+                "first_seed": first,
+                "last_seed": last,
+                "seeds_sha256": sha,
+                "fingerprint": fp,
+                "summary": summary,
+            },
+            f,
+            sort_keys=True,
+        )
+    os.replace(tmp, path)
 
 
 def run_sweep_chunked_resumable(
@@ -101,8 +198,6 @@ def run_sweep_chunked_resumable(
     sweep) raises instead of silently merging foreign counts. For mid-chunk snapshots of in-flight state
     use ``save_sweep``/``resume_sweep`` instead.
     """
-    import hashlib
-    import json
     import os
 
     from .core import _concat_finals, _pad_seeds, run_sweep
@@ -121,58 +216,197 @@ def run_sweep_chunked_resumable(
     for lo in range(0, n, chunk_size):
         k = min(chunk_size, n - lo)
         first, last = int(seeds_host[lo]), int(seeds_host[lo + k - 1])
-        # endpoints alone can collide across different seed vectors
-        # ([0,5,9] vs [0,7,9]); hash the whole chunk's seeds
-        seeds_sha = hashlib.sha256(
-            np.ascontiguousarray(seeds_host[lo : lo + k]).tobytes()
-        ).hexdigest()
+        seeds_sha = _chunk_sha(seeds_host, lo, k)
         path = os.path.join(ckpt_dir, f"chunk_{lo:010d}_{k}.json")
         if os.path.exists(path):
-            with open(path) as f:
-                rec = json.load(f)
-            # records from before the sha was added lack the key; their
-            # endpoint+fingerprint check still applies (legacy-compatible)
-            rec_sha = rec.get("seeds_sha256", seeds_sha)
-            if (
-                rec["first_seed"] != first
-                or rec["last_seed"] != last
-                or rec_sha != seeds_sha
-                or rec.get("fingerprint") != fp
-            ):
-                raise ValueError(
-                    f"checkpoint {path} is from a different sweep: holds "
-                    f"seeds [{rec['first_seed']}, {rec['last_seed']}] "
-                    f"(sha {rec.get('seeds_sha256')!r}) with "
-                    f"fingerprint {rec.get('fingerprint')!r}, expected "
-                    f"[{first}, {last}] (sha {seeds_sha!r}) with {fp!r}"
-                )
-            summary = rec["summary"]
+            summary = _load_chunk_summary(path, first, last, seeds_sha, fp)
         else:
             # pad a ragged final chunk so it reuses the one compiled
             # sweep program (a fresh batch shape recompiles for seconds);
-            # padded lanes are trimmed inside one jitted program
+            # a limit-aware summarize (models/_common.make_sweep_summary)
+            # masks the padded lanes inside the SAME compiled summary
+            # program, so the ragged chunk compiles nothing at all —
+            # otherwise the padded lanes are trimmed by a (one-off)
+            # k-shaped trim program
             chunk = seeds[lo : lo + chunk_size]
             pad = chunk_size - k
             final = run_sweep(
                 workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
             )
+            if pad and getattr(summarize, "supports_limit", False):
+                summary = summarize(final, limit=k)
+            else:
+                if pad:
+                    final = _concat_finals(k, final)
+                summary = summarize(final)
+            _write_chunk_summary(path, first, last, seeds_sha, fp, summary)
+        merge_summaries(totals, summary)
+    return totals
+
+
+def run_sweep_pipelined(
+    workload: Workload,
+    cfg: EngineConfig,
+    seeds,
+    summarize,
+    *,
+    host_work: Optional[Callable] = None,
+    screen: Optional[Callable] = None,
+    chunk_size: int = 16384,
+    ckpt_dir: Optional[str] = None,
+    stop_after: Optional[int] = None,
+    resume_from: Optional[Tuple[EngineState, dict]] = None,
+) -> dict:
+    """Chunked sweep with the host phase of chunk N overlapped against
+    the device sweep of chunk N+1 — the driver that makes END-TO-END
+    checked throughput (sweep + screen + check) the optimized quantity
+    instead of raw sweep speed.
+
+    Per chunk, in dispatch order:
+
+    1. **device phase** — the chunk's sweep is enqueued, and ``screen``
+       (``final -> bool[S]`` suspect mask, e.g.
+       ``oracle.screen.screen_sweep``) is enqueued right behind it; both
+       stay un-materialized device values.
+    2. the PREVIOUS chunk's **host phase** runs while the device crunches
+       this chunk: ``host_work(final, lo=, n=, seeds=, suspect=,
+       summary=)`` gets the previous chunk's finished state, its host
+       suspect mask (``np.asarray`` here costs a device->host transfer
+       that overlaps compute, not a sync), and its summary dict; the
+       dict it returns is folded into that chunk's summary. Decode,
+       checking, triage — anything host-Python — belongs here.
+    3. ``summarize(final)`` blocks until this chunk's sweep completes
+       (its reduction program was enqueued behind the sweep, so the
+       device never idles on it).
+
+    A ragged final chunk is padded to ``chunk_size`` for program reuse;
+    a limit-aware ``summarize`` masks the padded lanes in-program, and
+    ``host_work`` always receives the trimmed real lanes.
+
+    ``ckpt_dir`` makes the pipeline preemption-safe at chunk granularity
+    exactly like ``run_sweep_chunked_resumable`` (per-chunk summary
+    JSONs with seed-sha + workload fingerprint guards, written AFTER the
+    chunk's host phase, atomically): a restarted call skips finished
+    chunks and recomputes at most the in-flight one — bit-identical, as
+    chunks are deterministic. ``stop_after`` returns after that many
+    chunks were computed this call (preemption drills and tests).
+    ``resume_from=(state, inflight)`` — a mid-chunk snapshot written by
+    ``save_sweep(state, path, inflight={"lo": ..., "k": ...})`` and read
+    back by ``load_sweep``/``load_inflight`` — finishes the in-flight
+    chunk from its saved state instead of restarting it (checkpoint
+    format v7), which is what keeps interrupt/resume bit-identical with
+    overlap enabled.
+
+    Determinism: chunk summaries merge in seed order regardless of
+    overlap, and ``host_work`` must be a pure function of its chunk (the
+    oracle's screened checker is), so the merged totals are byte-stable
+    across pipelining, worker-pool sizes, and interruption points.
+    """
+    import os
+
+    from .core import _concat_finals, _pad_seeds, run_sweep, _drive
+    from ..models._common import merge_summaries  # lazy: models import us
+
+    seeds = jnp.asarray(seeds, jnp.int64)
+    seeds_host = np.asarray(seeds)
+    n = int(seeds.shape[0])
+    if n == 0:
+        raise ValueError("seed batch is empty")
+    if chunk_size < 1:
+        raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
+    fp = _sweep_fingerprint(workload, cfg)
+    if ckpt_dir is not None:
+        os.makedirs(ckpt_dir, exist_ok=True)
+    supports_limit = bool(getattr(summarize, "supports_limit", False))
+    resume_lo = int(resume_from[1]["lo"]) if resume_from is not None else -1
+
+    totals: dict = {}
+    pending = None  # previous chunk awaiting its host phase
+    computed = 0
+
+    def flush(p) -> None:
+        lo, k, sha, final, susp, summary, path = p
+        if host_work is not None:
+            extra = host_work(
+                final,
+                lo=lo,
+                n=k,
+                seeds=seeds_host[lo : lo + k],
+                suspect=None if susp is None else np.asarray(susp)[:k],
+                summary=summary,
+            )
+            if extra:
+                summary = {**summary, **extra}
+        if path is not None:
+            _write_chunk_summary(
+                path, int(seeds_host[lo]), int(seeds_host[lo + k - 1]),
+                sha, fp, summary,
+            )
+        merge_summaries(totals, summary)
+
+    for lo in range(0, n, chunk_size):
+        k = min(chunk_size, n - lo)
+        sha = _chunk_sha(seeds_host, lo, k)
+        path = (
+            os.path.join(ckpt_dir, f"pchunk_{lo:010d}_{k}.json")
+            if ckpt_dir is not None
+            else None
+        )
+        if path is not None and os.path.exists(path):
+            summary = _load_chunk_summary(
+                path, int(seeds_host[lo]), int(seeds_host[lo + k - 1]),
+                sha, fp,
+            )
+            if pending is not None:
+                flush(pending)  # keep merge order = seed order
+                pending = None
+            merge_summaries(totals, summary)
+            continue
+
+        # -- device phase: enqueue this chunk's sweep (+ screen) --------
+        pad = chunk_size - k if n > chunk_size else 0
+        if lo == resume_lo:
+            state, inflight = resume_from
+            if int(inflight.get("k", k)) != k or not np.array_equal(
+                np.asarray(state.seed)[:k], seeds_host[lo : lo + k]
+            ):
+                raise ValueError(
+                    f"resume_from snapshot does not match chunk at {lo}: "
+                    f"inflight={inflight!r}"
+                )
+            final = _drive(workload, cfg, state)
+        else:
+            chunk = seeds[lo : lo + chunk_size]
+            final = run_sweep(
+                workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
+            )
+        susp = screen(final) if screen is not None else None
+
+        # -- previous chunk's host phase overlaps this chunk's sweep ----
+        if pending is not None:
+            flush(pending)
+            pending = None
+
+        # -- this chunk's summary (blocks until its sweep completes) ----
+        if pad and supports_limit:
+            summary = summarize(final, limit=k)
+        else:
             if pad:
                 final = _concat_finals(k, final)
             summary = summarize(final)
-            tmp = path + ".tmp"
-            with open(tmp, "w") as f:
-                json.dump(
-                    {
-                        "first_seed": first,
-                        "last_seed": last,
-                        "seeds_sha256": seeds_sha,
-                        "fingerprint": fp,
-                        "summary": summary,
-                    },
-                    f,
-                )
-            os.replace(tmp, path)  # atomic: a crash never leaves half a file
-        merge_summaries(totals, summary)
+        if pad and supports_limit and host_work is not None:
+            # the host phase must never see the padded lanes (their
+            # synthetic seeds would pollute e.g. violating-seed lists)
+            final = _concat_finals(k, final)
+        if susp is not None and pad:
+            susp = susp[:k]
+        pending = (lo, k, sha, final, susp, summary, path)
+        computed += 1
+        if stop_after is not None and computed >= stop_after:
+            break
+
+    if pending is not None:
+        flush(pending)
     return totals
 
 

@@ -35,6 +35,7 @@ from typing import Any, Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import queue as equeue
 from .queue import EventQueue
@@ -414,8 +415,68 @@ def run_in_chunks(run_chunk, seeds, chunk_size: int, multiple: int = 1):
     return _concat_finals(n, *finals)
 
 
+def state_bytes_per_seed(workload: Workload, cfg: EngineConfig) -> int:
+    """Loop-carry bytes ONE seed lane holds through the sweep loop —
+    the quantity whose batch-sized total stops fitting fast memory at
+    the occupancy cliff (docs/pallas_finding.md §5). Computed from the
+    abstract shapes of ``_init_one`` (no device work, no compile)."""
+    shapes = jax.eval_shape(
+        partial(_init_one, workload, cfg), jax.ShapeDtypeStruct((), jnp.int64)
+    )
+    total = 0
+    for leaf in jax.tree.leaves(shapes):
+        try:
+            itemsize = leaf.dtype.itemsize
+        except (AttributeError, TypeError):
+            itemsize = 8  # typed PRNG key: two uint32 words
+        if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            itemsize = 8
+        total += int(np.prod(leaf.shape, dtype=np.int64)) * itemsize
+    return total
+
+
+# The batch-occupancy knee, as a loop-carry budget: BENCH r05 measured
+# the 16,384-seed MadRaft batch (a ~100 MB carry) at full speed and the
+# 65,536-seed batch (~4x) at ~0.75x seeds/s — the marginal per-step cost
+# cliffs ~9x once the carry stops fitting fast memory (docs/
+# pallas_finding.md §3/§5). 128 MiB keeps the auto-picked chunk at or
+# below the measured knee for every bundled model; override with
+# MADSIM_CHUNK_BUDGET_BYTES (or the explicit argument) after remeasuring
+# bench.py's batch_curve on new hardware.
+DEFAULT_CHUNK_BUDGET_BYTES = 128 * 1024 * 1024
+
+
+def pick_chunk_size(
+    workload: Workload,
+    cfg: EngineConfig,
+    budget_bytes: Optional[int] = None,
+    lo: int = 1024,
+    hi: int = 65536,
+) -> int:
+    """Largest power-of-two batch in ``[lo, hi]`` whose loop carry fits
+    the fast-memory budget — the measured knee of the batch curve, not a
+    guess. This is what ``run_sweep_chunked`` / the pipelined driver use
+    when no explicit chunk size is given, so a history-recording
+    workload (whose per-seed carry is several times a bare one's)
+    automatically sweeps in smaller chunks instead of falling off the
+    65k-seed cliff."""
+    if budget_bytes is None:
+        import os
+
+        budget_bytes = int(
+            os.environ.get(
+                "MADSIM_CHUNK_BUDGET_BYTES", DEFAULT_CHUNK_BUDGET_BYTES
+            )
+        )
+    per_seed = max(1, state_bytes_per_seed(workload, cfg))
+    size = lo
+    while size * 2 <= hi and size * 2 * per_seed <= budget_bytes:
+        size *= 2
+    return size
+
+
 def run_sweep_chunked(
-    workload: Workload, cfg: EngineConfig, seeds, chunk_size: int = 16384
+    workload: Workload, cfg: EngineConfig, seeds, chunk_size: Optional[int] = None
 ) -> EngineState:
     """Run a large seed sweep as sequential ``chunk_size`` batches of
     ONE compiled program, concatenating the final states.
@@ -425,13 +486,17 @@ def run_sweep_chunked(
     stops fitting fast memory), so a 100k+ sweep runs several times
     faster as 16k chunks than as one giant batch — and a chunk is also
     the natural checkpoint/restart granule. Bit-identical to one big
-    ``run_sweep`` per seed (seeds are independent).
+    ``run_sweep`` per seed (seeds are independent). ``chunk_size=None``
+    auto-picks the knee of the batch curve from the workload's measured
+    loop-carry footprint (``pick_chunk_size``).
 
     The returned state keeps O(total seeds) device memory (per-seed
     event queues included) — fine to a few hundred thousand seeds on one
     chip. At the million-seed scale, don't hold finals at all: merge
     per-chunk ``sweep_summary`` dicts on host per chunk, as bench.py's
     bench_100k does."""
+    if chunk_size is None:
+        chunk_size = pick_chunk_size(workload, cfg)
     return run_in_chunks(
         lambda chunk: run_sweep(workload, cfg, chunk), seeds, chunk_size
     )

@@ -417,7 +417,10 @@ def run_megasweep(state: EngineState, steps: int,
         raise ValueError(
             "run_megasweep does not append op-history records (the probe "
             "workload records none); a record-enabled workload would "
-            "silently report an empty history"
+            "silently report an empty history — and downstream, the "
+            "device history screen (oracle/screen.py) would clear every "
+            "seed as boring. Checked sweeps go through the XLA driver "
+            "(engine/checkpoint.run_sweep_pipelined)"
         )
     qn = state.queue.time.shape[1]
     qp = qn  # Mosaic pads lanes internally; keep logical width

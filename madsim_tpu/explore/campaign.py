@@ -35,7 +35,6 @@ from typing import List, NamedTuple, Optional, Tuple
 
 import numpy as np
 
-from ..engine import core as ecore
 from ..engine.faults import FaultSpec, FixedFaults, prob_to_q32
 from ..models._common import coverage_bit_count
 from .targets import Target
@@ -216,36 +215,58 @@ def _sweep_candidate(
     seeds = np.arange(
         ccfg.seed0, ccfg.seed0 + ccfg.seeds_per_round, dtype=np.int64
     )
-    # never let the chunk granule exceed the round budget: the resumable
-    # driver pads a ragged chunk to the full chunk_size for program
+    # never let the chunk granule exceed the round budget: the chunk
+    # drivers pad a ragged chunk to the full chunk_size for program
     # reuse, which would blow a 128-seed explore round up to a
     # 16k-lane sweep
     chunk_size = min(ccfg.chunk_size, ccfg.seeds_per_round)
 
-    def summarize(final) -> dict:
-        s = dict(target.summarize(final))
-        vio = np.asarray(target.violating(final))
-        s["violating_seeds"] = [int(x) for x in vio[: ccfg.max_recorded_seeds]]
-        if "violations" not in s:
+    # history targets hand the pipeline their device screen so it is
+    # enqueued right behind each chunk's sweep; launching it from the
+    # host phase instead would queue it behind the NEXT chunk's sweep
+    # on the single device stream and serialize the whole pipeline
+    screen_fn = None
+    if target.hist_spec is not None:
+        from ..oracle.screen import screen_for, screen_sweep
+
+        if screen_for(target.hist_spec) is not None:
+            def screen_fn(final):
+                return screen_sweep(final, target.hist_spec)
+
+    def host_work(final, *, lo, n, seeds, suspect, summary) -> dict:
+        # the expensive half — checking may run the WGL search per
+        # suspect lane — runs in the pipeline's overlapped host phase,
+        # concurrent with the device sweep of the next chunk
+        del lo, n, seeds
+        if suspect is not None:
+            # consume the mask the device phase already computed
+            # (identical seeds to target.violating, by conservatism)
+            from ..oracle.check import violating_seeds
+
+            vio = violating_seeds(
+                final, target.hist_spec, screen=lambda _f: suspect
+            )
+        else:
+            vio = np.asarray(target.violating(final))
+        out = {
+            "violating_seeds": [int(x) for x in vio[: ccfg.max_recorded_seeds]]
+        }
+        if "violations" not in summary:
             # the uncapped truth, so the round record never under-reports
             # for a target whose summary lacks the key (sums per chunk)
-            s["violations"] = int(vio.size)
-        return s
+            out["violations"] = int(vio.size)
+        return out
 
-    if round_dir is not None:
-        # resumable leg: per-chunk summaries checkpoint through the
-        # existing machinery; a restarted campaign regenerates the same
-        # candidate (pure function of campaign_seed) and skips chunks
-        from ..engine.checkpoint import run_sweep_chunked_resumable
+    # one driver for both legs: with round_dir the per-chunk summaries
+    # checkpoint (a restarted campaign regenerates the same candidate —
+    # pure function of campaign_seed — and skips finished chunks);
+    # without it the pipeline still overlaps checking with sweeping
+    from ..engine.checkpoint import run_sweep_pipelined
 
-        return run_sweep_chunked_resumable(
-            workload, ecfg, seeds, summarize, round_dir,
-            chunk_size=chunk_size,
-        )
-    final = ecore.run_sweep_chunked(
-        workload, ecfg, seeds, chunk_size=chunk_size
+    return run_sweep_pipelined(
+        workload, ecfg, seeds, target.summarize, host_work=host_work,
+        screen=screen_fn, chunk_size=chunk_size, ckpt_dir=round_dir,
     )
-    return summarize(final)
 
 
 def run_campaign(

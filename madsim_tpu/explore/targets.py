@@ -102,13 +102,12 @@ def stale_etcd_target(
     register spec. Pass ``bug_stale_read=False`` for the matching clean
     control (the checker must stay quiet over any pinned seed range)."""
     from ..models import etcd
-    from ..oracle import KVSpec
     from ..oracle.check import violating_seeds as history_violating
 
     base_cfg = etcd.EtcdConfig(
         bug_stale_read=bug_stale_read, hist_slots=hist_slots
     )
-    spec = KVSpec()
+    spec = etcd.history_spec()
 
     def build(faults) -> Tuple[Workload, EngineConfig]:
         cfg = base_cfg._replace(faults=faults)
@@ -127,6 +126,10 @@ def stale_etcd_target(
         num_nodes=base_cfg.num_nodes,
         fault_kind=etcd.K_FAULT,
         node_of=node_of,
-        violating=lambda final: history_violating(final, spec),
+        # screened: the device first pass (oracle/screen.py) clears the
+        # boring lanes and WGL runs on the suspects only — identical
+        # seeds by the conservatism contract, so campaign loops can use
+        # the oracle as their red-seed signal at sweep speed
+        violating=lambda final: history_violating(final, spec, screen=True),
         hist_spec=spec,
     )

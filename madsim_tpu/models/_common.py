@@ -83,9 +83,15 @@ def memoized_workload(cfg_cls):
 def make_sweep_summary(
     fields: Tuple[Tuple[str, Callable], ...]
 ) -> Callable[[object], dict]:
-    """Build a ``sweep_summary(final) -> dict`` from ``(name, reduce_fn)``
-    pairs, where each ``reduce_fn(final)`` is a scalar reduction over the
-    batched EngineState.
+    """Build a ``sweep_summary(final) -> dict`` from ``(name, lane_fn)``
+    pairs, where each ``lane_fn(final)`` returns a PER-LANE vector
+    ``[S]`` over the batched EngineState; the reduction (sum, or max
+    for ``MAX_KEYS`` names) is owned here. Per-lane on purpose: it lets
+    the ``limit=`` variant mask padded lanes out of every field
+    EXACTLY — a zeroed lane is the identity of sum, of max over the
+    nonnegative fields, and of the coverage OR, whereas predicate
+    fields like raft's ``elections == 0`` would miscount zeroed lanes
+    if masking happened below the field function.
 
     All reductions run in ONE jitted device program that stacks the
     scalars into a single int64 vector, so the whole summary costs one
@@ -93,42 +99,82 @@ def make_sweep_summary(
     ``np.asarray`` per field — moves each full per-lane array to host
     and pays a round-trip per field, which dominates chunked pod-scale
     sweeps on a tunneled device (~0.9 s/chunk at 12 fields x 16k lanes)."""
-    # EngineState-level reductions shared by every model, appended here
-    # so a new model (or engine counter) can't silently drop them
+    # EngineState-level per-lane fields shared by every model, appended
+    # here so a new model (or engine counter) can't silently drop them
     engine_fields = (
-        ("overflow_seeds", lambda f: jnp.sum(f.overflow)),
-        ("hist_overflow_seeds", lambda f: jnp.sum(f.hist_overflow)),
-        ("queue_high_water", lambda f: jnp.max(f.qmax)),
-        ("events_total", lambda f: jnp.sum(f.ctr)),
-        ("sim_ns_total", lambda f: jnp.sum(f.now_ns)),
+        ("overflow_seeds", lambda f: f.overflow),
+        ("hist_overflow_seeds", lambda f: f.hist_overflow),
+        ("queue_high_water", lambda f: f.qmax),
+        ("events_total", lambda f: f.ctr),
+        ("sim_ns_total", lambda f: f.now_ns),
     )
     fields = fields + engine_fields
     names = tuple(n for n, _ in fields)
     fns = tuple(f for _, f in fields)
 
-    @jax.jit
-    def _summarize(final):
-        scalars = jnp.stack([jnp.asarray(f(final), jnp.int64) for f in fns])
+    def _reduce(final, m):
+        cols = []
+        for name, fn in zip(names, fns):
+            lanes = jnp.asarray(fn(final), jnp.int64)
+            if lanes.ndim != 1:
+                # catch the pre-round-6 contract at trace time: a field
+                # written as a scalar reduction (lambda f: jnp.sum(...))
+                # would survive whole-chunk summaries but silently
+                # multiply by the lane count under the limit mask
+                raise ValueError(
+                    f"sweep_summary field {name!r} must return a "
+                    f"PER-LANE vector [S], got shape {lanes.shape} — "
+                    "drop the jnp.sum/jnp.max: the reduction is owned "
+                    "by make_sweep_summary (docs/authoring_models.md)"
+                )
+            if m is not None:
+                lanes = jnp.where(m, lanes, jnp.int64(0))
+            cols.append(
+                jnp.max(lanes) if name in MAX_KEYS else jnp.sum(lanes)
+            )
         # coverage union rides in the same program/transfer: OR the
         # per-seed bitmaps down the batch axis — the "one extra
         # reduction" that turns the engine's in-loop signal into a
         # chunk-level coverage map (explore/campaign.py feeds on it)
+        cover = final.cover
+        if m is not None:
+            cover = jnp.where(m[:, None], cover, jnp.uint32(0))
         union = jax.lax.reduce(
-            final.cover, jnp.uint32(0), jax.lax.bitwise_or, (0,)
+            cover, jnp.uint32(0), jax.lax.bitwise_or, (0,)
         )
-        return scalars, union
+        return jnp.stack(cols), union
 
-    def sweep_summary(final) -> dict:
+    _summarize = jax.jit(lambda final: _reduce(final, None))
+
+    @jax.jit
+    def _summarize_limit(final, k):
+        # mask the padded lanes instead of slicing: one compiled
+        # program serves EVERY ragged tail length, where a [k]-shaped
+        # trim would recompile per distinct k
+        return _reduce(final, jnp.arange(final.seed.shape[0]) < k)
+
+    def sweep_summary(final, limit=None) -> dict:
         """Reduction of a finished sweep's batched EngineState (one
-        device program, one transfer)."""
-        vec, union = _summarize(final)
+        device program, one transfer). ``limit=k`` reduces only the
+        first ``k`` lanes — the padded-ragged-chunk path: the masked
+        variant is ONE compiled program for all ``k``, so a ragged
+        final chunk costs no recompile (engine/checkpoint.py drivers
+        and scripts/sweep_million.py rely on this)."""
+        if limit is None:
+            vec, union = _summarize(final)
+            seeds = int(final.seed.shape[0])
+        else:
+            vec, union = _summarize_limit(final, jnp.asarray(limit, jnp.int32))
+            seeds = int(limit)
         vec = np.asarray(vec)
-        out = {"seeds": int(final.seed.shape[0])}
+        out = {"seeds": seeds}
         out.update((n, int(v)) for n, v in zip(names, vec))
         if union.shape[0]:
             out["coverage_map"] = [int(w) for w in np.asarray(union)]
         return out
 
+    # the chunk drivers key program-reuse decisions on this marker
+    sweep_summary.supports_limit = True
     return sweep_summary
 
 ExtraSlot = Optional[Tuple]  # (time, kind, pay, enable) or DISABLED

@@ -616,6 +616,16 @@ def _init(cfg: EtcdConfig, key):
     )
 
 
+def history_spec():
+    """The sequential spec this model's recorded histories check
+    against (oracle/specs.KVSpec) — also the key the device screen
+    dispatches on (oracle/screen.screen_for), so a checked sweep needs
+    no per-call-site spec plumbing."""
+    from ..oracle.specs import KVSpec
+
+    return KVSpec()
+
+
 @_common.memoized_workload(EtcdConfig)
 def workload(cfg: EtcdConfig = None) -> Workload:
     """Build the engine Workload for an etcd sweep configuration
@@ -657,18 +667,18 @@ def engine_config(cfg: EtcdConfig = EtcdConfig(), **overrides) -> EngineConfig:
 # _common.make_sweep_summary
 sweep_summary = _common.make_sweep_summary(
     (
-        ("violations", lambda f: jnp.sum(f.wstate.violation)),
-        ("rev_regress_seeds", lambda f: jnp.sum(f.wstate.vio_rev)),
-        ("expiry_seeds", lambda f: jnp.sum(f.wstate.vio_expiry)),
-        ("puts", lambda f: jnp.sum(f.wstate.puts)),
-        ("gets", lambda f: jnp.sum(f.wstate.gets)),
-        ("keepalives", lambda f: jnp.sum(f.wstate.keepalives)),
-        ("grants", lambda f: jnp.sum(f.wstate.grants)),
-        ("expiries", lambda f: jnp.sum(f.wstate.expiries)),
-        ("keys_expired", lambda f: jnp.sum(f.wstate.keys_expired)),
-        ("partitions", lambda f: jnp.sum(f.wstate.parts)),
-        ("final_rev", lambda f: jnp.sum(f.wstate.rev)),
-        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
-        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+        ("violations", lambda f: f.wstate.violation),
+        ("rev_regress_seeds", lambda f: f.wstate.vio_rev),
+        ("expiry_seeds", lambda f: f.wstate.vio_expiry),
+        ("puts", lambda f: f.wstate.puts),
+        ("gets", lambda f: f.wstate.gets),
+        ("keepalives", lambda f: f.wstate.keepalives),
+        ("grants", lambda f: f.wstate.grants),
+        ("expiries", lambda f: f.wstate.expiries),
+        ("keys_expired", lambda f: f.wstate.keys_expired),
+        ("partitions", lambda f: f.wstate.parts),
+        ("final_rev", lambda f: f.wstate.rev),
+        ("msgs_sent", lambda f: f.wstate.msgs_sent),
+        ("msgs_delivered", lambda f: f.wstate.msgs_delivered),
     )
 )

@@ -662,6 +662,16 @@ def _init(cfg: KafkaConfig, key):
     )
 
 
+def history_spec():
+    """The sequential spec this model's recorded histories check
+    against (oracle/specs.LogSpec) — also the key the device screen
+    dispatches on (oracle/screen.screen_for), so a checked sweep needs
+    no per-call-site spec plumbing."""
+    from ..oracle.specs import LogSpec
+
+    return LogSpec()
+
+
 @_common.memoized_workload(KafkaConfig)
 def workload(cfg: KafkaConfig = None) -> Workload:
     """Build the engine Workload for a Kafka sweep configuration
@@ -701,17 +711,17 @@ def engine_config(cfg: KafkaConfig = KafkaConfig(), **overrides) -> EngineConfig
 # _common.make_sweep_summary
 sweep_summary = _common.make_sweep_summary(
     (
-        ("violations", lambda f: jnp.sum(f.wstate.violation)),
-        ("ack_loss_seeds", lambda f: jnp.sum(f.wstate.vio_ack_loss)),
-        ("watermark_seeds", lambda f: jnp.sum(f.wstate.vio_watermark)),
-        ("produced", lambda f: jnp.sum(f.wstate.produced)),
-        ("appended", lambda f: jnp.sum(f.wstate.appended)),
-        ("acked", lambda f: jnp.sum(f.wstate.acked)),
-        ("fetched", lambda f: jnp.sum(f.wstate.fetched)),
-        ("flushes", lambda f: jnp.sum(f.wstate.flushes)),
-        ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
-        ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
-        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
-        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+        ("violations", lambda f: f.wstate.violation),
+        ("ack_loss_seeds", lambda f: f.wstate.vio_ack_loss),
+        ("watermark_seeds", lambda f: f.wstate.vio_watermark),
+        ("produced", lambda f: f.wstate.produced),
+        ("appended", lambda f: f.wstate.appended),
+        ("acked", lambda f: f.wstate.acked),
+        ("fetched", lambda f: f.wstate.fetched),
+        ("flushes", lambda f: f.wstate.flushes),
+        ("crashes", lambda f: f.wstate.crash_count),
+        ("log_overflow_seeds", lambda f: f.wstate.log_overflow),
+        ("msgs_sent", lambda f: f.wstate.msgs_sent),
+        ("msgs_delivered", lambda f: f.wstate.msgs_delivered),
     )
 )

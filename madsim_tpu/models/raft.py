@@ -839,6 +839,16 @@ def _init(cfg: RaftConfig, key):
     )
 
 
+def history_spec():
+    """The sequential spec this model's recorded histories check
+    against (oracle/specs.ElectionSpec) — also the key the device
+    screen dispatches on (oracle/screen.screen_for), so a checked sweep
+    needs no per-call-site spec plumbing."""
+    from ..oracle.specs import ElectionSpec
+
+    return ElectionSpec()
+
+
 @_common.memoized_workload(RaftConfig)
 def workload(cfg: RaftConfig = None) -> Workload:
     """Build the engine Workload for a Raft sweep configuration
@@ -884,14 +894,14 @@ def engine_config(cfg: RaftConfig = RaftConfig(), **overrides) -> EngineConfig:
 # _common.make_sweep_summary
 sweep_summary = _common.make_sweep_summary(
     (
-        ("violations", lambda f: jnp.sum(f.wstate.violation)),
-        ("elections_total", lambda f: jnp.sum(f.wstate.elections)),
-        ("no_leader_seeds", lambda f: jnp.sum(f.wstate.elections == 0)),
-        ("commits_total", lambda f: jnp.sum(f.wstate.commits)),
-        ("accepted_cmds", lambda f: jnp.sum(f.wstate.accepted_cmds)),
-        ("cmd_giveups", lambda f: jnp.sum(f.wstate.cmd_giveups)),
-        ("log_overflow_seeds", lambda f: jnp.sum(f.wstate.log_overflow)),
-        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
-        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+        ("violations", lambda f: f.wstate.violation),
+        ("elections_total", lambda f: f.wstate.elections),
+        ("no_leader_seeds", lambda f: f.wstate.elections == 0),
+        ("commits_total", lambda f: f.wstate.commits),
+        ("accepted_cmds", lambda f: f.wstate.accepted_cmds),
+        ("cmd_giveups", lambda f: f.wstate.cmd_giveups),
+        ("log_overflow_seeds", lambda f: f.wstate.log_overflow),
+        ("msgs_sent", lambda f: f.wstate.msgs_sent),
+        ("msgs_delivered", lambda f: f.wstate.msgs_delivered),
     )
 )

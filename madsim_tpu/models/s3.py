@@ -655,19 +655,22 @@ def engine_config(cfg: S3Config = S3Config(), **overrides) -> EngineConfig:
 # _common.make_sweep_summary
 sweep_summary = _common.make_sweep_summary(
     (
-        ("violations", lambda f: jnp.sum(f.wstate.violation)),
-        ("ack_loss_seeds", lambda f: jnp.sum(f.wstate.vio_ack_loss)),
-        ("regress_seeds", lambda f: jnp.sum(f.wstate.vio_regress)),
-        ("puts", lambda f: jnp.sum(f.wstate.puts)),
-        ("gets", lambda f: jnp.sum(f.wstate.gets)),
-        ("dels", lambda f: jnp.sum(f.wstate.dels)),
-        ("creates", lambda f: jnp.sum(f.wstate.creates)),
-        ("parts", lambda f: jnp.sum(f.wstate.parts_recv)),
-        ("completes", lambda f: jnp.sum(f.wstate.completes)),
-        ("upload_restarts", lambda f: jnp.sum(f.wstate.upload_restarts)),
-        ("crashes", lambda f: jnp.sum(f.wstate.crash_count)),
-        ("ops_done", lambda f: jnp.sum(f.wstate.ops_done)),
-        ("msgs_sent", lambda f: jnp.sum(f.wstate.msgs_sent)),
-        ("msgs_delivered", lambda f: jnp.sum(f.wstate.msgs_delivered)),
+        ("violations", lambda f: f.wstate.violation),
+        ("ack_loss_seeds", lambda f: f.wstate.vio_ack_loss),
+        ("regress_seeds", lambda f: f.wstate.vio_regress),
+        ("puts", lambda f: f.wstate.puts),
+        ("gets", lambda f: f.wstate.gets),
+        ("dels", lambda f: f.wstate.dels),
+        ("creates", lambda f: f.wstate.creates),
+        ("parts", lambda f: f.wstate.parts_recv),
+        ("completes", lambda f: f.wstate.completes),
+        ("upload_restarts", lambda f: f.wstate.upload_restarts),
+        ("crashes", lambda f: f.wstate.crash_count),
+        # ops_done is per-client [S, NC]: fold the client axis here so
+        # the field hands make_sweep_summary the per-LANE vector its
+        # contract (and the limit mask) requires
+        ("ops_done", lambda f: jnp.sum(f.wstate.ops_done, axis=-1)),
+        ("msgs_sent", lambda f: f.wstate.msgs_sent),
+        ("msgs_delivered", lambda f: f.wstate.msgs_delivered),
     )
 )

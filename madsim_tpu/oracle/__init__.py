@@ -15,17 +15,29 @@ the Porcupine/WGL checker family). Three pieces:
 - ``specs`` — pluggable sequential specs (KV register for etcd,
   per-partition ordered log for kafka);
 - ``check`` — a WGL-style linearizability search with memoized state
-  hashing, per-key partitioning, and first-bad-prefix location.
+  hashing, per-key partitioning, and first-bad-prefix location;
+- ``screen`` — a conservative device-side first pass (imported lazily:
+  it is the one jax-dependent module here) that flags suspect seeds as
+  masked reductions over the SoA history plane, so the WGL search runs
+  only where it might find something (``checked_sweep`` is the
+  pipelined sweep+screen+check driver).
 
 See docs/oracle.md for the record-hook contract and complexity caveats.
 """
 
-from .check import CheckResult, check_history, first_bad_prefix, violating_seeds
+from .check import (
+    CheckResult,
+    check_histories,
+    check_history,
+    first_bad_prefix,
+    violating_seeds,
+)
 from .history import (
     OP_NAMES,
     History,
     HostRecorder,
     Op,
+    decode_lanes,
     decode_seed,
     decode_sweep,
     history_bytes,
@@ -34,6 +46,7 @@ from .specs import ElectionSpec, KVSpec, LogSpec
 
 __all__ = [
     "CheckResult",
+    "check_histories",
     "check_history",
     "first_bad_prefix",
     "violating_seeds",
@@ -41,6 +54,7 @@ __all__ = [
     "History",
     "HostRecorder",
     "Op",
+    "decode_lanes",
     "decode_seed",
     "decode_sweep",
     "history_bytes",

@@ -164,17 +164,135 @@ def check_history(
     )
 
 
-def violating_seeds(final, spec: Spec, max_states: int = 200_000) -> np.ndarray:
+def _check_job(args) -> CheckResult:
+    """Top-level worker body (must pickle) for ``check_histories``."""
+    hist, spec, max_states = args
+    return check_history(hist, spec, max_states=max_states)
+
+
+def check_histories(
+    hists: Sequence[History],
+    spec: Spec,
+    max_states: int = 200_000,
+    workers: int = 0,
+) -> List[CheckResult]:
+    """Check a batch of histories, optionally fanned over a process
+    pool — the host half of the screened checked-sweep pipeline
+    (oracle/screen.py + engine/checkpoint.run_sweep_pipelined).
+
+    Determinism contract: results are returned in input order and each
+    verdict is a pure function of ``(history, spec, max_states)``, so
+    the worker count can only change wall-clock, never a byte of any
+    downstream report (scripts/check_determinism.sh gates this).
+    ``workers <= 1`` checks inline; the pool workers are clean
+    interpreters (forkserver/spawn — never a fork of THIS process,
+    whose JAX runtime threads make mid-pipeline forks deadlock-prone)
+    importing only the numpy-side checker modules, and the pool is
+    created once per worker count. Falls back to inline checking where
+    no multiprocessing context is available."""
+    hists = list(hists)
+    if workers and workers > 1 and len(hists) > 1:
+        ex = _pool(workers)
+        if ex is not None:
+            from concurrent.futures.process import BrokenProcessPool
+
+            jobs = [(h, spec, max_states) for h in hists]
+            try:
+                return list(
+                    ex.map(
+                        _check_job,
+                        jobs,
+                        chunksize=max(1, len(jobs) // (workers * 4)),
+                    )
+                )
+            except BrokenProcessPool:
+                # a worker died (OOM on a pathological history, OS
+                # kill): the executor is permanently broken, so evict
+                # it — the NEXT call re-forks a fresh pool — and check
+                # this batch inline (same results: pure per-history
+                # function) instead of failing the remaining chunks
+                _POOLS.pop(workers, None)
+                ex.shutdown(wait=False, cancel_futures=True)
+    return [check_history(h, spec, max_states=max_states) for h in hists]
+
+
+def _pool(workers: int):
+    """Process pool for ``check_histories``, cached per worker count —
+    a checked sweep calls in once per chunk, and re-spawning a pool per
+    chunk would cost more than small chunks' checking. NOT the fork
+    context: by the time the pipeline's host phase runs, this process
+    carries live JAX dispatch threads, and forking a multithreaded
+    process can deadlock the child inside a held lock — a hung (not
+    dead) worker never breaks the pool, so the whole sweep would block.
+    forkserver (preferred: its server is a clean single-threaded
+    process that forks cheap workers) or spawn both start workers as
+    fresh interpreters importing only the numpy-side checker modules —
+    a one-time ~0.3 s/worker tax the persistent pool amortizes.
+    Returns None where neither context exists (callers check inline)."""
+    import multiprocessing as mp
+    from concurrent.futures import ProcessPoolExecutor
+
+    ex = _POOLS.get(workers)
+    if ex is None and workers not in _POOLS:
+        ctx = None
+        for method in ("forkserver", "spawn"):
+            try:
+                ctx = mp.get_context(method)
+                break
+            except ValueError:
+                continue
+        ex = (
+            ProcessPoolExecutor(max_workers=workers, mp_context=ctx)
+            if ctx is not None
+            else None
+        )
+        _POOLS[workers] = ex
+    return ex
+
+
+_POOLS: dict = {}
+
+
+def violating_seeds(
+    final,
+    spec: Spec,
+    max_states: int = 200_000,
+    screen=None,
+    workers: int = 0,
+) -> np.ndarray:
     """Seeds of a finished sweep whose decoded history the checker
     rejects — the history oracle's counterpart of
     ``replay.violation_seeds`` (model-latched flags). Overflowed
     histories are checked on their valid prefix (the buffer never
-    wraps), so a reported seed is always a proven violation."""
-    from .history import decode_sweep
+    wraps), so a reported seed is always a proven violation.
 
-    out = [
-        h.seed
-        for h in decode_sweep(final)
-        if not check_history(h, spec, max_states=max_states).ok
-    ]
+    ``screen=True`` runs the device-side first pass (oracle/screen.py)
+    and decodes + checks only the suspect lanes — identical results by
+    the screen's conservatism contract, at a fraction of the host cost
+    (raises for a spec with no device screen); ``screen="auto"`` does
+    the same but quietly degrades to checking every lane for unscreened
+    specs; a callable screens with ``screen(final) -> bool[S]``.
+    ``workers`` fans the checker over a process pool
+    (``check_histories``)."""
+    from .history import decode_lanes, decode_sweep
+
+    if screen == "auto":
+        from .screen import screen_for
+
+        screen = screen_for(spec) is not None
+    if screen is None or screen is False:
+        hists = decode_sweep(final)
+    else:
+        from .screen import screen_sweep
+
+        mask = (
+            screen(final)
+            if callable(screen)
+            else screen_sweep(final, spec)
+        )
+        hists = decode_lanes(final, np.nonzero(np.asarray(mask))[0])
+    results = check_histories(
+        hists, spec, max_states=max_states, workers=workers
+    )
+    out = [h.seed for h, r in zip(hists, results) if not r.ok]
     return np.asarray(out, dtype=np.int64)

@@ -147,18 +147,47 @@ def decode_seed(final, lane: Optional[int] = None) -> History:
     )
 
 
+def decode_lanes(final, lanes) -> List[History]:
+    """Decode SELECTED lanes of a batched sweep state — the screened
+    path's batch decoder (oracle/screen.py): the device planes come off
+    the device once, then only the suspect lanes pay the per-row Python
+    decode. ``lanes`` is any integer sequence; order is preserved.
+
+    The device->host transfer is sized to the selection, not the chunk:
+    an empty selection never touches the device (the clean-sweep common
+    case), and a sparse one (< a quarter of the lanes — the screened
+    case) gathers the suspect rows device-side first, so a 16k-lane
+    chunk with a handful of suspects moves kilobytes, not the whole
+    ~100 MB plane, through a possibly-tunneled link."""
+    lanes = [int(lane) for lane in lanes]
+    if not lanes:
+        return []
+    n_total = int(final.seed.shape[0])
+    if len(lanes) * 4 <= n_total:
+        idx = np.asarray(lanes)
+        planes = (
+            final.hist_rec[idx], final.hist_t[idx],
+            final.hist_len[idx], final.hist_overflow[idx],
+            final.seed[idx],
+        )
+        sel = range(len(lanes))
+    else:
+        planes = (
+            final.hist_rec, final.hist_t, final.hist_len,
+            final.hist_overflow, final.seed,
+        )
+        sel = lanes
+    rec, t, length, ov, seeds = (np.asarray(p) for p in planes)
+    return [
+        decode_rows(rec[i], t[i], length[i], ov[i], seed=int(seeds[i]))
+        for i in sel
+    ]
+
+
 def decode_sweep(final) -> List[History]:
     """Decode every lane of a batched sweep state (host-side loop; pull
     the arrays off the device once, not per lane)."""
-    rec = np.asarray(final.hist_rec)
-    t = np.asarray(final.hist_t)
-    length = np.asarray(final.hist_len)
-    ov = np.asarray(final.hist_overflow)
-    seeds = np.asarray(final.seed)
-    return [
-        decode_rows(rec[i], t[i], length[i], ov[i], seed=int(seeds[i]))
-        for i in range(seeds.shape[0])
-    ]
+    return decode_lanes(final, range(int(final.seed.shape[0])))
 
 
 def history_bytes(hist: History) -> bytes:

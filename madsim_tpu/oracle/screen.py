@@ -1,0 +1,394 @@
+"""Vectorized on-device history screening — the oracle's first pass.
+
+The WGL checker (oracle/check.py) is per-seed host Python: decode ~a
+hundred rows, search linearizations. At 100k+ seeds the checker, not the
+engine, is the wall-clock bound of a checked sweep. This module moves a
+conservative first pass onto the device: per-key quick-checks computed
+as masked reductions over the SoA history plane (``EngineState.hist_*``)
+of a finished chunk, yielding one bool per seed — *suspect* or
+*provably boring*. Full decoding + WGL search then runs only on the
+suspect lanes.
+
+The contract is CONSERVATISM: the suspect set must be a superset of the
+seeds the full checker would reject, so skipping the clean lanes never
+hides a violation. Each screen is therefore built from conditions of
+the form "flag unless this observation is provably explainable":
+
+- ``kv`` (etcd register spec): a completed GET is flagged when it read
+  ABSENT after some PUT on its key definitely committed, when no PUT of
+  the observed value was even invoked before the read returned, or when
+  a *fresher* observation exists — some op completed before the read
+  began whose invoke followed the commit of the read's value (a
+  definitely-newer committed write, or an earlier read that already
+  observed a newer value — the latter catches value flip-flops that no
+  write pair alone can witness). Duplicate written values and DEL rows
+  defeat the value-identity reasoning, so their mere presence flags the
+  seed (the bundled etcd model records neither).
+- ``log`` (kafka ordered-log spec): a completed FETCH at offset ``o``
+  serving ``n`` records is flagged when fewer than ``o + n`` PRODUCE
+  invocations preceded its completion, or when it breaks per-consumer
+  offset contiguity (the exact structural pre-check of
+  ``specs.LogSpec``, which appends OK rows in completion order).
+- ``election`` (raft): two ELECT rows naming different winners for one
+  term — exactly ``specs.ElectionSpec.structural``, so this screen is
+  precise (no false positives, no misses).
+
+Unknown op kinds, DEL rows, and OK rows with no recorded invoke flag
+the seed wholesale: a row the screen cannot reason about must not be
+silently trusted. Overflowed histories screen their valid prefix — the
+same prefix the checker checks (the buffer never wraps).
+
+What the screen can NOT do is *prove* a violation: a flagged seed is a
+candidate, and only the WGL search's verdict counts. The false-positive
+rate on clean sweeps is bounded by construction (most conditions are
+exact necessary-condition checks; tests/test_screen.py pins it <5%),
+which is what makes screening a throughput win rather than a shortcut.
+
+Everything here is jittable JAX over int32/int64 planes — [H, H]
+pairwise masks reduced per seed, vmapped over lanes in blocks — so the
+screen of a 16k-seed chunk is one device program, enqueued right behind
+the chunk's sweep (engine/checkpoint.run_sweep_pipelined overlaps the
+host-side checking of chunk N with the device sweep of chunk N+1).
+"""
+
+from __future__ import annotations
+
+from functools import lru_cache
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .history import (
+    OP_ELECT,
+    OP_FETCH,
+    OP_GET,
+    OP_PRODUCE,
+    OP_PUT,
+    PH_INVOKE,
+    PH_OK,
+)
+from .specs import ABSENT
+
+# int64 sentinels: "no such time" below/above any virtual timestamp
+_T_NEG = jnp.int64(-(1 << 62))
+_T_INF = jnp.int64(1 << 62)
+_I32_MIN = jnp.int32(-(1 << 31))
+
+
+def _cols(rec, t, n):
+    """Split one seed's raw rows into masked columns."""
+    H = rec.shape[0]
+    idx = jnp.arange(H, dtype=jnp.int32)
+    valid = idx < jnp.asarray(n, jnp.int32)
+    client, code, key, val, opid = (rec[:, i] for i in range(5))
+    op, ph = code // 2, code % 2
+    return idx, valid, client, op, ph, key, val, opid, jnp.asarray(t)
+
+
+def _invoke_join(idx, valid, client, op, ph, opid, t):
+    """For every OK row, the time of its invoke row (and the pair mask).
+
+    The decoder pairs an OK row with the LATEST earlier matching invoke
+    (kafka produce retries re-invoke one opid), so the join takes the
+    max time over candidates. Rows with no match get ``_T_NEG`` —
+    callers flag those (an OK without an invoke is a contract breach the
+    decoder would raise on)."""
+    pair = (
+        (valid & (ph == PH_OK))[:, None]
+        & (valid & (ph == PH_INVOKE))[None, :]
+        & (client[:, None] == client[None, :])
+        & (op[:, None] == op[None, :])
+        & (opid[:, None] == opid[None, :])
+        & (idx[None, :] < idx[:, None])
+    )
+    inv_t = jnp.max(jnp.where(pair, t[None, :], _T_NEG), axis=1)
+    return inv_t, pair
+
+
+def kv_suspect(rec, t, n) -> jnp.ndarray:
+    """One seed's suspect bit under the KV register spec (etcd)."""
+    idx, valid, client, op, ph, key, val, opid, t = _cols(rec, t, n)
+    inv_t, _ = _invoke_join(idx, valid, client, op, ph, opid, t)
+
+    put_inv = valid & (op == OP_PUT) & (ph == PH_INVOKE)
+    put_ok = valid & (op == OP_PUT) & (ph == PH_OK)
+    get_ok = valid & (op == OP_GET) & (ph == PH_OK)
+    obs_ok = put_ok | get_ok
+
+    # rows the value-identity reasoning cannot cover flag the seed
+    unscreenable = jnp.any(valid & ~((op == OP_PUT) | (op == OP_GET)))
+    orphan = jnp.any((valid & (ph == PH_OK)) & (inv_t == _T_NEG))
+
+    same_key = key[:, None] == key[None, :]
+
+    # two distinct PUT invokes of one (key, value): value identity no
+    # longer names a unique write — flag (values are random 31-bit
+    # draws in the bundled model, so this is vanishingly rare)
+    dup = jnp.any(
+        put_inv[:, None]
+        & put_inv[None, :]
+        & same_key
+        & (val[:, None] == val[None, :])
+        & (idx[:, None] < idx[None, :])
+    )
+
+    # commit time of the unique PUT that wrote (key_i, out_i); an
+    # unacked (open) write commits "never" — nothing can be proven to
+    # follow it, so the freshness conditions below stay quiet
+    wrote = put_ok[None, :] & same_key & (val[:, None] == val[None, :])
+    cmp_v = jnp.where(
+        jnp.any(wrote, axis=1),
+        jnp.max(jnp.where(wrote, t[None, :], _T_NEG), axis=1),
+        _T_INF,
+    )
+
+    ti = inv_t  # a GET-OK row's invoke time
+    tc = t  # ... and its completion time (the row's own stamp)
+
+    # ABSENT read after some PUT on the key definitely committed (the
+    # recorded keys are never deleted — DEL rows flag above)
+    bad_absent = (val == ABSENT) & jnp.any(
+        put_ok[None, :] & same_key & (t[None, :] < ti[:, None]), axis=1
+    )
+    # observed value that no PUT even invoked before the read returned
+    no_writer = (val != ABSENT) & ~jnp.any(
+        put_inv[None, :]
+        & same_key
+        & (val[:, None] == val[None, :])
+        & (t[None, :] <= tc[:, None]),
+        axis=1,
+    )
+    # a fresher observation: some completed op on the key observed or
+    # wrote a DIFFERENT value, began after this read's value committed,
+    # and finished before this read began — in every linearization that
+    # op sits between the read's write and the read, so the read is
+    # provably stale (unique values; duplicates flag above)
+    fresher = (val != ABSENT) & jnp.any(
+        obs_ok[None, :]
+        & same_key
+        & (val[:, None] != val[None, :])
+        & (t[None, :] < ti[:, None])
+        & (inv_t[None, :] > cmp_v[:, None]),
+        axis=1,
+    )
+    bad = get_ok & (bad_absent | no_writer | fresher)
+    return jnp.any(bad) | dup | unscreenable | orphan
+
+
+def log_suspect(rec, t, n) -> jnp.ndarray:
+    """One seed's suspect bit under the ordered-log spec (kafka)."""
+    idx, valid, client, op, ph, key, val, opid, t = _cols(rec, t, n)
+    inv_t, pair = _invoke_join(idx, valid, client, op, ph, opid, t)
+
+    prod_inv = valid & (op == OP_PRODUCE) & (ph == PH_INVOKE)
+    fetch_ok = valid & (op == OP_FETCH) & (ph == PH_OK)
+
+    unscreenable = jnp.any(valid & ~((op == OP_PRODUCE) | (op == OP_FETCH)))
+    orphan = jnp.any(fetch_ok & (inv_t == _T_NEG))
+
+    same_key = key[:, None] == key[None, :]
+
+    # each fetch's offset rides on its (latest matching) invoke row
+    jlast = jnp.max(jnp.where(pair, idx[None, :], jnp.int32(-1)), axis=1)
+    onehot = pair & (idx[None, :] == jlast[:, None])
+    off = jnp.max(jnp.where(onehot, val[None, :], _I32_MIN), axis=1)
+    served = val  # a FETCH-OK row's val column is the records served
+
+    # overread: serving past every append that could precede it — each
+    # PRODUCE op (retries included: the spec counts them as separate
+    # appends) invoked before this fetch completed may linearize first
+    navail = jnp.sum(
+        (prod_inv[None, :] & same_key & (t[None, :] <= t[:, None])).astype(
+            jnp.int32
+        ),
+        axis=1,
+    )
+    overread = fetch_ok & (off + served > navail)
+
+    # per-consumer committed-offset contiguity, in completion order (OK
+    # rows append at completion, so row order IS completion order) —
+    # exactly specs.LogSpec.structural
+    prevm = (
+        fetch_ok[:, None]
+        & fetch_ok[None, :]
+        & same_key
+        & (client[:, None] == client[None, :])
+        & (idx[None, :] < idx[:, None])
+    )
+    jprev = jnp.max(jnp.where(prevm, idx[None, :], jnp.int32(-1)), axis=1)
+    sel_prev = prevm & (idx[None, :] == jprev[:, None])
+    prev_off = jnp.max(jnp.where(sel_prev, off[None, :], _I32_MIN), axis=1)
+    prev_served = jnp.max(jnp.where(sel_prev, val[None, :], _I32_MIN), axis=1)
+    expect = jnp.where(jprev >= 0, prev_off + prev_served, jnp.int32(0))
+    gap = fetch_ok & (off != expect)
+
+    return jnp.any(overread | gap) | unscreenable | orphan
+
+
+def election_suspect(rec, t, n) -> jnp.ndarray:
+    """One seed's suspect bit under the election spec (raft) — precise:
+    two ELECT rows naming different winners for one term, exactly
+    ``specs.ElectionSpec.structural``."""
+    idx, valid, client, op, ph, key, val, opid, t = _cols(rec, t, n)
+    elect = valid & (op == OP_ELECT) & (ph == PH_INVOKE)
+    unscreenable = jnp.any(valid & ~(op == OP_ELECT))
+    split = jnp.any(
+        elect[:, None]
+        & elect[None, :]
+        & (key[:, None] == key[None, :])
+        & (val[:, None] != val[None, :])
+    )
+    return split | unscreenable
+
+
+_SCREENS = {
+    "kv": kv_suspect,
+    "log": log_suspect,
+    "election": election_suspect,
+}
+
+
+def screen_for(spec) -> Optional[Callable]:
+    """The per-seed screen function for a sequential spec, by its
+    ``name`` — or None when no screen exists (callers must then treat
+    every seed as suspect)."""
+    return _SCREENS.get(getattr(spec, "name", None))
+
+
+@lru_cache(maxsize=None)
+def _batched(name: str):
+    return jax.jit(jax.vmap(_SCREENS[name]))
+
+
+def screen_history(rec, t, n, spec) -> bool:
+    """Screen ONE seed's raw history rows (tests and replay tooling)."""
+    fn = screen_for(spec)
+    if fn is None:
+        raise ValueError(f"no device screen for spec {spec.name!r}")
+    return bool(
+        fn(jnp.asarray(rec, jnp.int32), jnp.asarray(t, jnp.int64), int(n))
+    )
+
+
+def screen_sweep(final, spec, block: int = 1024) -> jnp.ndarray:
+    """Suspect mask (bool[S], device array) for a finished batched sweep.
+
+    ``block`` bounds the [block, H, H] pairwise-mask working set per
+    launched program (H = hist_slots; 1024 lanes x 256 rows is ~67 MB of
+    bool mask per term). The mask is NOT materialized to host — callers
+    enqueue this right after the chunk's sweep and ``np.asarray`` it
+    later, from the overlapped host phase."""
+    fn = screen_for(spec)
+    if fn is None:
+        raise ValueError(
+            f"no device screen for spec {getattr(spec, 'name', spec)!r}; "
+            "pass screen=False (check every lane) instead"
+        )
+    S = int(final.seed.shape[0])
+    if final.hist_rec.shape[1] == 0:
+        # no recording plane: nothing to screen, nothing to check —
+        # consistent with the checker accepting every empty history
+        return jnp.zeros((S,), bool)
+    f = _batched(spec.name)
+    if S <= block:
+        return f(final.hist_rec, final.hist_t, final.hist_len)
+    outs = [
+        f(
+            final.hist_rec[lo : lo + block],
+            final.hist_t[lo : lo + block],
+            final.hist_len[lo : lo + block],
+        )
+        for lo in range(0, S, block)
+    ]
+    return jnp.concatenate(outs)
+
+
+def history_host_work(
+    spec,
+    max_states: int = 200_000,
+    workers: int = 0,
+    max_recorded: int = 32,
+) -> Callable:
+    """Build the ``host_work`` callback for a screened checked sweep
+    (engine/checkpoint.run_sweep_pipelined): decode the suspect lanes,
+    fan the WGL checker over a process pool, and fold the verdicts into
+    the chunk summary.
+
+    Determinism contract: the returned dict is a pure function of the
+    chunk's history planes — worker count changes wall-clock only, never
+    a byte of the report (results are ordered by lane, and each
+    verdict is a pure function of one history)."""
+    from .check import check_histories
+    from .history import decode_lanes
+
+    def host_work(final, *, lo, n, seeds, suspect, summary):
+        del lo, seeds, summary
+        if suspect is None:
+            lanes = np.arange(n)
+        else:
+            lanes = np.nonzero(np.asarray(suspect)[:n])[0]
+        hists = decode_lanes(final, lanes)
+        results = check_histories(
+            hists, spec, max_states=max_states, workers=workers
+        )
+        bad = [int(h.seed) for h, r in zip(hists, results) if not r.ok]
+        undecided = sum(1 for r in results if not r.decided)
+        return {
+            "hist_screened": int(n),
+            "hist_suspects": int(lanes.size),
+            "hist_violations": len(bad),
+            "hist_undecided": int(undecided),
+            "hist_violating_seeds": bad[:max_recorded],
+        }
+
+    return host_work
+
+
+def checked_sweep(
+    workload,
+    cfg,
+    seeds,
+    spec,
+    summarize,
+    chunk_size: int = 16384,
+    workers: int = 0,
+    max_states: int = 200_000,
+    screen: bool = True,
+    ckpt_dir: Optional[str] = None,
+    stop_after: Optional[int] = None,
+    resume_from=None,
+) -> dict:
+    """End-to-end checked sweep: pipelined chunked sweep + on-device
+    screening + process-pool WGL checking, merged into one summary dict.
+
+    This is the optimized quantity BENCH reports as ``checked_sweep``:
+    seeds/s through simulation AND history validation. ``screen=False``
+    degrades to decode-and-check-every-seed (the naive baseline).
+    Results are bit-identical across ``screen`` settings whenever the
+    screen is conservative, and across ``workers`` always."""
+    from ..engine.checkpoint import run_sweep_pipelined
+
+    screen_fn = None
+    if screen:
+        if screen_for(spec) is None:
+            raise ValueError(
+                f"spec {spec.name!r} has no device screen; pass "
+                "screen=False to check every lane"
+            )
+        screen_fn = lambda final: screen_sweep(final, spec)  # noqa: E731
+    return run_sweep_pipelined(
+        workload,
+        cfg,
+        seeds,
+        summarize,
+        host_work=history_host_work(
+            spec, max_states=max_states, workers=workers
+        ),
+        screen=screen_fn,
+        chunk_size=chunk_size,
+        ckpt_dir=ckpt_dir,
+        stop_after=stop_after,
+        resume_from=resume_from,
+    )

@@ -33,7 +33,14 @@ ABSENT = -1  # the value-column encoding of "key not present"
 
 
 class Spec:
-    """Base sequential spec; subclasses override the three methods."""
+    """Base sequential spec; subclasses override the three methods.
+
+    ``name`` is identity, not decoration: the device-side screen
+    (oracle/screen.py) dispatches its conservative first pass on it, so
+    a subclass that reuses a bundled name inherits that screen's
+    conservatism assumptions — a spec with *stricter* semantics than
+    its namesake must pick a fresh name (and go unscreened) rather than
+    risk the screen clearing seeds its checker would reject."""
 
     name = "spec"
 

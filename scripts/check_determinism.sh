@@ -102,6 +102,30 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     exit 1
   fi
 
+  # pipelined checked-sweep leg (docs/oracle.md "Screening and
+  # pipelining"): the screened+pooled checked-sweep report must be
+  # byte-identical across two processes x two worker-pool sizes —
+  # pipelining overlap, the device screen, and the process-pool fan-out
+  # may change wall-clock only, never a report byte.
+  for w in 0 2; do
+    for r in a b; do
+      JAX_PLATFORMS=cpu "${PY:-python}" scripts/checked_sweep_demo.py \
+        --seeds 96 --chunk-size 32 --workers "$w" \
+        --report "$out/cs_${r}_w${w}.json" >"$out/cs_${r}_w${w}.log" 2>&1
+    done
+  done
+  if [ -s "$out/cs_a_w0.json" ] \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_w0.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_w2.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_w2.json"; then
+    echo "determinism gate: OK (checked sweep, 2 processes x 2 pool sizes, byte-identical)"
+  else
+    echo "determinism gate: FAILED — checked-sweep reports differ or are empty" >&2
+    for f in "$out"/cs_*.json; do echo "--- $f"; cat "$f"; done >&2 || true
+    cat "$out"/cs_*.log >&2 || true
+    exit 1
+  fi
+
   # differential leg: the host<->device differential report
   # (docs/faults.md gray failures) must be byte-identical across two
   # processes — a small matched grid here; the full 200-seed tolerance

@@ -7,8 +7,12 @@ chunks of one compiled program, merging per-chunk summaries on host
 engine.core.run_sweep_chunked). Prints one JSON line.
 
 Any total works: a ragged final chunk is padded to the full chunk size
-(the padded lanes' counts are trimmed out of its summary inside one
-jitted program), so every chunk still reuses the single compiled sweep.
+and its summary is computed through the LIMIT-MASKED reduction
+(models/_common.make_sweep_summary ``limit=``), so the ragged tail
+reuses both the compiled sweep program AND the compiled summary program
+— zero recompiles in the timed region, which the summary line proves by
+counting ``Finished XLA compilation`` events (``jax.log_compiles``)
+while the timed loop runs.
 
 Usage: python scripts/sweep_million.py [total_seeds] [ckpt_dir]
 
@@ -20,9 +24,11 @@ restarted run skips completed chunks.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import sys
 import time
+from contextlib import contextmanager
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -33,7 +39,36 @@ from madsim_tpu.engine import core
 from madsim_tpu.models import raft
 from madsim_tpu.models._common import merge_summaries
 
-CHUNK = 16384
+# env-overridable so smoke runs can exercise the multi-chunk + ragged
+# paths without paying for 16k-lane compiles
+CHUNK = int(os.environ.get("MADSIM_SWEEP_CHUNK", 16384))
+
+
+class _CompileCounter(logging.Handler):
+    """Counts finished XLA compilations surfaced by ``jax.log_compiles``
+    — the honest program-reuse measurement: a ragged final chunk that
+    recompiles anything shows up here, self-reported shape bookkeeping
+    does not count."""
+
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.count = 0
+
+    def emit(self, record):
+        if "Finished XLA compilation" in record.getMessage():
+            self.count += 1
+
+
+@contextmanager
+def count_compiles():
+    handler = _CompileCounter()
+    logger = logging.getLogger("jax")
+    logger.addHandler(handler)
+    try:
+        with jax.log_compiles(True):
+            yield handler
+    finally:
+        logger.removeHandler(handler)
 
 
 def main() -> None:
@@ -42,50 +77,65 @@ def main() -> None:
     ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000)
     wl = raft.workload(cfg)
 
+    base = 1 << 30
+    tail = total % CHUNK if total > CHUNK else 0
+
     # compile once outside the timed region — at the batch shape the
     # timed loop will actually run (a sub-chunk total compiles and runs
-    # at its own exact shape; see `mult` below)
+    # at its own exact shape), including the limit-masked summary
+    # program a ragged tail will hit
+    # ... the warm seed range sits just below ``base`` so the offset
+    # arange (an eager iota+add) is compiled here too, not in the loop
     warm_n = CHUNK if total > CHUNK else total
-    warm = core.run_sweep(wl, ecfg, jnp.arange(warm_n, dtype=jnp.int64))
+    warm = core.run_sweep(
+        wl, ecfg, jnp.arange(base - warm_n, base, dtype=jnp.int64)
+    )
     raft.sweep_summary(warm)
+    if tail:
+        raft.sweep_summary(warm, limit=tail)
 
     ckpt_dir = sys.argv[2] if len(sys.argv) > 2 else None
     chunks_preloaded = 0
-    t0 = time.perf_counter()
-    if ckpt_dir:
-        import glob
-        import os
+    with count_compiles() as compiles:
+        t0 = time.perf_counter()
+        if ckpt_dir:
+            import glob
 
-        from madsim_tpu.engine.checkpoint import run_sweep_chunked_resumable
-
-        chunks_preloaded = len(glob.glob(os.path.join(ckpt_dir, "chunk_*.json")))
-        seeds = jnp.arange(1 << 30, (1 << 30) + total, dtype=jnp.int64)
-        # clamp the chunk granule to the total so a sub-chunk run is not
-        # padded up to a full 16k-lane sweep (mirrors `mult` below)
-        totals = run_sweep_chunked_resumable(
-            wl, ecfg, seeds, raft.sweep_summary, ckpt_dir,
-            chunk_size=min(CHUNK, total),
-        )
-    else:
-        totals = {}
-        # pad a ragged FINAL chunk to the compiled 16k shape only when an
-        # earlier full chunk already paid for that program; a sub-chunk
-        # total compiles its own exact shape instead of simulating (and
-        # discarding) up to 16x padded lanes
-        mult = CHUNK if total > CHUNK else 1
-        for lo in range(1 << 30, (1 << 30) + total, CHUNK):
-            k = min(CHUNK, (1 << 30) + total - lo)
-            # run_in_chunks trims the padded lanes before returning;
-            # calling it per chunk keeps the constant-memory per-chunk
-            # summary merge this script exists to demonstrate
-            final = core.run_in_chunks(
-                lambda c: core.run_sweep(wl, ecfg, c),
-                jnp.arange(lo, lo + k, dtype=jnp.int64),
-                CHUNK,
-                multiple=mult,
+            from madsim_tpu.engine.checkpoint import (
+                run_sweep_chunked_resumable,
             )
-            merge_summaries(totals, raft.sweep_summary(final))
-    wall = time.perf_counter() - t0
+
+            chunks_preloaded = len(
+                glob.glob(os.path.join(ckpt_dir, "chunk_*.json"))
+            )
+            seeds = jnp.arange(base, base + total, dtype=jnp.int64)
+            # clamp the chunk granule to the total so a sub-chunk run is
+            # not padded up to a full 16k-lane sweep
+            totals = run_sweep_chunked_resumable(
+                wl, ecfg, seeds, raft.sweep_summary, ckpt_dir,
+                chunk_size=min(CHUNK, total),
+            )
+        else:
+            totals = {}
+            for lo in range(base, base + total, CHUNK):
+                k = min(CHUNK, base + total - lo)
+                if k < CHUNK and total > CHUNK:
+                    # ragged tail: extend the contiguous seed range to
+                    # the compiled chunk shape (value-identical to
+                    # core._pad_seeds' max+1+i filler) and mask the
+                    # padded lanes inside the one compiled summary
+                    # program — no trim program, no recompile, not even
+                    # an eager pad op
+                    final = core.run_sweep(
+                        wl, ecfg, jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
+                    )
+                    merge_summaries(totals, raft.sweep_summary(final, limit=k))
+                else:
+                    final = core.run_sweep(
+                        wl, ecfg, jnp.arange(lo, lo + k, dtype=jnp.int64)
+                    )
+                    merge_summaries(totals, raft.sweep_summary(final))
+        wall = time.perf_counter() - t0
 
     print(
         json.dumps(
@@ -105,6 +155,10 @@ def main() -> None:
                 # measurement when every chunk was computed this run
                 "chunks_loaded_from_checkpoint": chunks_preloaded,
                 "chunks_computed": -(-total // CHUNK) - chunks_preloaded,
+                # program reuse, measured: XLA compilations during the
+                # timed loop (0 = the warm-up paid for everything,
+                # ragged tail included)
+                "compiles_in_timed_region": compiles.count,
                 "backend": jax.default_backend(),
             }
         )

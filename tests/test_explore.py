@@ -158,7 +158,10 @@ def test_campaign_resumes_through_checkpoints(tmp_path):
     ccfg = CCFG._replace(rounds=2, stop_after_failures=0, seeds_per_round=64)
     ck = tmp_path / "ck"
     r1 = explore.run_campaign(target, BLAND, ccfg, ckpt_dir=str(ck))
-    files = sorted(p.name for p in (ck / "round_0000").glob("chunk_*.json"))
+    # the pipelined driver's chunk files (pchunk_*: their summaries
+    # carry host-phase results, so they are not interchangeable with
+    # run_sweep_chunked_resumable's chunk_* files)
+    files = sorted(p.name for p in (ck / "round_0000").glob("pchunk_*.json"))
     assert files, "no per-chunk checkpoints written"
     r2 = explore.run_campaign(target, BLAND, ccfg, ckpt_dir=str(ck))
     assert r1.records == r2.records

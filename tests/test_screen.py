@@ -1,0 +1,519 @@
+"""On-device history screening + the pipelined checked-sweep driver.
+
+Covers the round's throughput contract bottom-up: the per-spec screens
+on hand-written row planes against the WGL checker's verdicts (every
+checker-rejected history must be flagged; provably-clean ones must
+not), SWEEP-level conservatism on the seeded-bug models (screen-flagged
+seeds ⊇ checker-violating seeds for `bug_stale_read` etcd and amnesia
+raft) with the false-positive rate on clean sweeps bounded <5%, the
+limit-masked chunk summary (one compiled program for every ragged tail),
+the occupancy instrumentation (`state_bytes_per_seed` /
+`pick_chunk_size`), and the pipelined driver's determinism story:
+screened == naive, pool sizes byte-equal, chunk-checkpoint resume and
+mid-chunk (format v7 `inflight`) resume bit-identical.
+"""
+
+import os
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import replay
+from madsim_tpu.engine import checkpoint as eckpt
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.models import etcd, kafka, raft
+from madsim_tpu.models._common import merge_summaries
+from madsim_tpu.oracle import (
+    ElectionSpec,
+    KVSpec,
+    LogSpec,
+    check_history,
+    violating_seeds,
+)
+from madsim_tpu.oracle.history import (
+    OP_ELECT,
+    OP_FETCH,
+    OP_GET,
+    OP_PRODUCE,
+    OP_PUT,
+    PH_INVOKE,
+    PH_OK,
+    decode_rows,
+)
+from madsim_tpu.oracle.screen import (
+    checked_sweep,
+    screen_history,
+    screen_sweep,
+)
+
+SEEDS = jnp.arange(48, dtype=jnp.int64)
+
+ETCD_CLEAN = etcd.EtcdConfig(hist_slots=256)
+ETCD_BUG = etcd.EtcdConfig(hist_slots=256, bug_stale_read=True)
+
+
+def _ecfg(cfg, **kw):
+    kw.setdefault("time_limit_ns", 2_000_000_000)
+    kw.setdefault("max_steps", 20_000)
+    return etcd.engine_config(cfg, **kw)
+
+
+def _rows(*items, slots=16):
+    """Raw history planes from (client, op, phase, key, val, opid, t)
+    tuples — MUST be listed in time order (the engine appends rows in
+    dispatch order, which is what the screens assume)."""
+    rec = np.zeros((slots, 5), np.int32)
+    ts = np.zeros((slots,), np.int64)
+    for i, (c, op, ph, k, v, oid, t) in enumerate(items):
+        rec[i] = (c, op * 2 + ph, k, v, oid)
+        ts[i] = t
+    return rec, ts, len(items)
+
+
+def _agrees(spec, *items, slots=16):
+    """(screen suspect?, checker rejects?) for one hand-written history,
+    asserting the conservatism direction: rejected => suspect."""
+    rec, ts, n = _rows(*items, slots=slots)
+    suspect = screen_history(rec, ts, n, spec)
+    verdict = check_history(decode_rows(rec, ts, n, False), spec)
+    assert suspect or verdict.ok, (
+        f"screen cleared a history the checker rejects: {verdict.reason}"
+    )
+    return suspect, verdict.ok
+
+
+# -- the KV screen on hand-written histories ---------------------------------
+
+
+def test_kv_screen_flags_stale_read():
+    suspect, ok = _agrees(
+        KVSpec(),
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (0, OP_PUT, PH_INVOKE, 3, 7, 1, 150),
+        (0, OP_PUT, PH_OK, 3, 7, 1, 250),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 300),
+        (1, OP_GET, PH_OK, 3, 5, 0, 400),  # stale: 7 committed first
+    )
+    assert suspect and not ok
+
+
+def test_kv_screen_clears_concurrent_read():
+    """A read overlapping the put may see either value — linearizable,
+    and the screen must not flag it (it is exactly the case a naive
+    'latest committed value' latch would false-positive on)."""
+    suspect, ok = _agrees(
+        KVSpec(),
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 10),
+        (1, OP_GET, PH_OK, 3, -1, 0, 50),  # before the put lands
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (1, OP_GET, PH_INVOKE, 3, 0, 1, 160),
+        (1, OP_GET, PH_OK, 3, 5, 1, 200),
+    )
+    assert ok and not suspect
+
+
+def test_kv_screen_flags_read_flipflop():
+    """Two writes concurrent with EACH OTHER, later reads disagreeing on
+    their order — no write pair is 'definitely fresher', so only the
+    read-as-evidence condition can catch it (and must)."""
+    suspect, ok = _agrees(
+        KVSpec(),
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (1, OP_PUT, PH_INVOKE, 3, 7, 0, 5),
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (1, OP_PUT, PH_OK, 3, 7, 0, 110),
+        (0, OP_GET, PH_INVOKE, 3, 0, 1, 200),
+        (0, OP_GET, PH_OK, 3, 7, 1, 300),  # observed 7...
+        (1, OP_GET, PH_INVOKE, 3, 0, 1, 400),
+        (1, OP_GET, PH_OK, 3, 5, 1, 500),  # ...then 5 again: impossible
+    )
+    assert suspect and not ok
+
+
+def test_kv_screen_flags_phantom_and_absent():
+    s1, ok1 = _agrees(
+        KVSpec(),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 10),
+        (1, OP_GET, PH_OK, 3, 42, 0, 20),  # nobody ever wrote 42
+    )
+    assert s1 and not ok1
+    s2, ok2 = _agrees(
+        KVSpec(),
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 200),
+        (1, OP_GET, PH_OK, 3, -1, 0, 300),  # ABSENT after a commit
+    )
+    assert s2 and not ok2
+
+
+def test_kv_screen_clears_open_put_observed():
+    """A PUT whose ack was lost may still have taken effect; a later
+    read observing it is linearizable and must not be flagged."""
+    suspect, ok = _agrees(
+        KVSpec(),
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),  # never completes
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 300),
+        (1, OP_GET, PH_OK, 3, 5, 0, 400),
+    )
+    assert ok and not suspect
+
+
+# -- the log screen ----------------------------------------------------------
+
+
+def test_log_screen_flags_overread_and_gap():
+    s1, ok1 = _agrees(
+        LogSpec(),
+        (0, OP_PRODUCE, PH_INVOKE, 0, 0, 0, 0),
+        (0, OP_PRODUCE, PH_OK, 0, 1, 0, 50),
+        (4, OP_FETCH, PH_INVOKE, 0, 0, 0, 100),
+        (4, OP_FETCH, PH_OK, 0, 3, 0, 200),  # 3 records, 1 produce
+    )
+    assert s1 and not ok1
+    s2, ok2 = _agrees(
+        LogSpec(),
+        (0, OP_PRODUCE, PH_INVOKE, 0, 0, 0, 0),
+        (0, OP_PRODUCE, PH_OK, 0, 1, 0, 50),
+        (0, OP_PRODUCE, PH_INVOKE, 0, 1, 1, 60),
+        (0, OP_PRODUCE, PH_OK, 0, 2, 1, 110),
+        (4, OP_FETCH, PH_INVOKE, 0, 0, 0, 120),
+        (4, OP_FETCH, PH_OK, 0, 1, 0, 200),
+        (4, OP_FETCH, PH_INVOKE, 0, 2, 1, 300),
+        (4, OP_FETCH, PH_OK, 0, 1, 1, 400),  # skipped offset 1
+    )
+    assert s2 and not ok2
+
+
+def test_log_screen_clears_contiguous_fetches():
+    suspect, ok = _agrees(
+        LogSpec(),
+        (0, OP_PRODUCE, PH_INVOKE, 0, 0, 0, 0),
+        (0, OP_PRODUCE, PH_OK, 0, 1, 0, 50),
+        (0, OP_PRODUCE, PH_INVOKE, 0, 1, 1, 60),
+        (0, OP_PRODUCE, PH_OK, 0, 2, 1, 110),
+        (4, OP_FETCH, PH_INVOKE, 0, 0, 0, 120),
+        (4, OP_FETCH, PH_OK, 0, 1, 0, 200),
+        (4, OP_FETCH, PH_INVOKE, 0, 1, 1, 300),
+        (4, OP_FETCH, PH_OK, 0, 1, 1, 400),
+    )
+    assert ok and not suspect
+
+
+# -- the election screen (precise) -------------------------------------------
+
+
+def test_election_screen_matches_structural_exactly():
+    rec, ts, n = _rows(
+        (1, OP_ELECT, PH_INVOKE, 1, 1, 0, 0),
+        (2, OP_ELECT, PH_INVOKE, 2, 2, 1, 100),
+        (1, OP_ELECT, PH_INVOKE, 3, 1, 2, 200),
+    )
+    assert not screen_history(rec, ts, n, ElectionSpec())
+    rec, ts, n = _rows(
+        (1, OP_ELECT, PH_INVOKE, 1, 1, 0, 0),
+        (2, OP_ELECT, PH_INVOKE, 1, 2, 1, 100),  # term 1, second winner
+    )
+    assert screen_history(rec, ts, n, ElectionSpec())
+
+
+# -- sweep-level conservatism: the acceptance contract ----------------------
+
+
+@pytest.fixture(scope="module")
+def etcd_bug_final():
+    return ecore.run_sweep(etcd.workload(ETCD_BUG), _ecfg(ETCD_BUG), SEEDS)
+
+
+def test_screen_conservative_on_etcd_stale_bug(etcd_bug_final):
+    """Screen-flagged seeds ⊇ WGL-violating seeds on the seeded-bug
+    sweep, and the screened checker returns the identical violation set
+    at a fraction of the decode+search cost."""
+    final = etcd_bug_final
+    full = violating_seeds(final, KVSpec())
+    assert full.size >= 1, "bug sweep fixture found no violations"
+    mask = np.asarray(screen_sweep(final, KVSpec()))
+    suspects = set(np.asarray(final.seed)[mask].tolist())
+    assert set(full.tolist()) <= suspects
+    np.testing.assert_array_equal(
+        violating_seeds(final, KVSpec(), screen=True), full
+    )
+
+
+def test_screen_conservative_on_amnesia_raft():
+    """Same contract on the raft election histories — here the screen
+    is exactly the structural invariant, so flagged == violating."""
+    cfg, _ = replay.amnesia_raft_config()
+    cfg = cfg._replace(hist_slots=64)
+    ecfg = raft.engine_config(
+        cfg, time_limit_ns=3_000_000_000, max_steps=30_000
+    )
+    final = ecore.run_sweep(raft.workload(cfg), ecfg, SEEDS)
+    full = violating_seeds(final, ElectionSpec())
+    assert full.size >= 1, "amnesia sweep fixture found no violations"
+    mask = np.asarray(screen_sweep(final, ElectionSpec()))
+    np.testing.assert_array_equal(np.asarray(final.seed)[mask], full)
+    np.testing.assert_array_equal(
+        violating_seeds(final, ElectionSpec(), screen=True), full
+    )
+
+
+def test_screen_false_positive_rate_bounded_on_clean_sweeps():
+    """<5% suspects on clean sweeps — the bound that makes screening a
+    real throughput win (a screen that cries wolf re-serializes the
+    checker). The bundled screens are near-exact, so the observed rate
+    is typically zero; 5% is the contract, not the expectation."""
+    efinal = ecore.run_sweep(
+        etcd.workload(ETCD_CLEAN), _ecfg(ETCD_CLEAN), SEEDS
+    )
+    emask = np.asarray(screen_sweep(efinal, KVSpec()))
+    assert violating_seeds(efinal, KVSpec(), screen=True).size == 0
+    assert emask.mean() < 0.05, f"etcd FP rate {emask.mean():.2%}"
+    kcfg = kafka.KafkaConfig(hist_slots=512)
+    kecfg = kafka.engine_config(
+        kcfg, time_limit_ns=2_000_000_000, max_steps=20_000
+    )
+    kfinal = ecore.run_sweep(kafka.workload(kcfg), kecfg, SEEDS)
+    kmask = np.asarray(screen_sweep(kfinal, LogSpec()))
+    assert violating_seeds(kfinal, LogSpec(), screen=True).size == 0
+    assert kmask.mean() < 0.05, f"kafka FP rate {kmask.mean():.2%}"
+
+
+def test_screen_handles_overflowed_prefix(etcd_bug_final):
+    """An overflowed buffer screens its valid prefix — same rows the
+    checker checks, so conservatism survives truncation."""
+    tiny = ETCD_BUG._replace(hist_slots=24)
+    final = ecore.run_sweep(etcd.workload(tiny), _ecfg(tiny), SEEDS)
+    assert np.asarray(final.hist_overflow).any(), "fixture must overflow"
+    full = violating_seeds(final, KVSpec())
+    mask = np.asarray(screen_sweep(final, KVSpec()))
+    assert set(full.tolist()) <= set(np.asarray(final.seed)[mask].tolist())
+
+
+# -- the limit-masked summary & occupancy instrumentation --------------------
+
+
+def test_limit_summary_equals_trimmed_summary(etcd_bug_final):
+    final = etcd_bug_final
+    trimmed = ecore._concat_finals(30, final)
+    assert etcd.sweep_summary(final, limit=30) == etcd.sweep_summary(trimmed)
+    assert etcd.sweep_summary.supports_limit
+    # raft too (scripts/sweep_million.py's ragged-tail path)
+    cfg = raft.RaftConfig(num_nodes=3)
+    recfg = raft.engine_config(cfg, time_limit_ns=500_000_000)
+    rfinal = ecore.run_sweep(
+        raft.workload(cfg), recfg, jnp.arange(8, dtype=jnp.int64)
+    )
+    assert raft.sweep_summary(rfinal, limit=5) == raft.sweep_summary(
+        ecore._concat_finals(5, rfinal)
+    )
+
+
+def test_state_bytes_and_chunk_autopick():
+    wl0 = etcd.workload(ETCD_CLEAN._replace(hist_slots=0))
+    wl256 = etcd.workload(ETCD_CLEAN)
+    ecfg = _ecfg(ETCD_CLEAN)
+    b0 = ecore.state_bytes_per_seed(wl0, ecfg)
+    b256 = ecore.state_bytes_per_seed(wl256, ecfg)
+    # the history plane is 256 rows x (5 x int32 + int64) per seed
+    assert b256 - b0 == 256 * (5 * 4 + 8)
+    # auto-pick: power of two, in range, monotone in the carry size,
+    # and an explicit budget caps it
+    c0 = ecore.pick_chunk_size(wl0, ecfg)
+    c256 = ecore.pick_chunk_size(wl256, ecfg)
+    assert c0 & (c0 - 1) == 0 and 1024 <= c0 <= 65536
+    assert c256 <= c0
+    assert ecore.pick_chunk_size(wl256, ecfg, budget_bytes=1) == 1024
+    assert (
+        ecore.pick_chunk_size(wl256, ecfg, budget_bytes=1 << 62) == 65536
+    )
+
+
+def test_run_sweep_chunked_auto_matches_explicit():
+    seeds = jnp.arange(12, dtype=jnp.int64)
+    cfg = raft.RaftConfig(num_nodes=3)
+    recfg = raft.engine_config(cfg, time_limit_ns=500_000_000)
+    wl = raft.workload(cfg)
+    auto = ecore.run_sweep_chunked(wl, recfg, seeds)
+    explicit = ecore.run_sweep_chunked(wl, recfg, seeds, chunk_size=12)
+    for a, b in zip((auto.ctr, auto.now_ns), (explicit.ctr, explicit.now_ns)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# -- the pipelined driver ----------------------------------------------------
+
+
+def _serial_checked(wl, ecfg, seeds, spec, chunk_size):
+    """Reference totals: per-chunk sweep + summary + decode-everything
+    checking, merged in chunk order — what the pipeline must equal."""
+    from madsim_tpu.oracle import check_histories, decode_sweep
+
+    totals = {}
+    seeds = np.asarray(seeds)
+    for lo in range(0, len(seeds), chunk_size):
+        chunk = jnp.asarray(seeds[lo : lo + chunk_size])
+        pad = chunk_size - int(chunk.shape[0]) if len(seeds) > chunk_size else 0
+        final = ecore.run_sweep(
+            wl, ecfg, ecore._pad_seeds(chunk, pad) if pad else chunk
+        )
+        if pad:
+            final = ecore._concat_finals(int(chunk.shape[0]), final)
+        s = etcd.sweep_summary(final)
+        hists = decode_sweep(final)
+        bad = [
+            int(h.seed)
+            for h, r in zip(hists, check_histories(hists, spec))
+            if not r.ok
+        ]
+        s.update(
+            {
+                "hist_screened": len(hists),
+                "hist_suspects": len(hists),
+                "hist_violations": len(bad),
+                "hist_undecided": 0,
+                "hist_violating_seeds": bad[:32],
+            }
+        )
+        merge_summaries(totals, s)
+    return totals
+
+
+def test_pipelined_checked_sweep_matches_serial_and_pool_sizes(
+    etcd_bug_final,
+):
+    """The determinism triangle: screened+pipelined == naive serial
+    (conservatism makes the skip invisible), and the pool size never
+    changes a byte. Ragged total on purpose (40 = 2x16 + 8)."""
+    del etcd_bug_final  # ordering hint only: reuse the compiled sweep
+    wl, ecfg = etcd.workload(ETCD_BUG), _ecfg(ETCD_BUG)
+    seeds = jnp.arange(40, dtype=jnp.int64)
+    spec = etcd.history_spec()
+    serial = _serial_checked(wl, ecfg, seeds, spec, 16)
+    piped = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16
+    )
+    pooled = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16, workers=2
+    )
+    naive = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16,
+        screen=False,
+    )
+    assert pooled == piped
+    drop = lambda d: {k: v for k, v in d.items() if k != "hist_suspects"}
+    assert drop(naive) == drop(piped)
+    assert serial == naive
+    assert piped["hist_violations"] >= 1
+    assert piped["hist_suspects"] <= piped["hist_screened"]
+
+
+def test_campaign_screened_history_target():
+    """A coverage + history target routes its device screen through the
+    pipeline's screen= hook (not the host phase, which would serialize
+    behind the next chunk's sweep) and its host phase consumes the
+    precomputed suspect mask — record determinism and violating-seed
+    equality with a direct screened check prove the plumbing."""
+    from madsim_tpu.explore.campaign import CampaignConfig, run_campaign
+    from madsim_tpu.explore.targets import Target
+
+    cfg, _ = replay.amnesia_raft_config()
+    cfg = cfg._replace(hist_slots=64)
+    spec = raft.history_spec()
+
+    def build(faults):
+        c = cfg._replace(faults=faults)
+        return raft.workload(c), raft.engine_config(
+            c, time_limit_ns=3_000_000_000, max_steps=30_000
+        )
+
+    target = Target(
+        name="raft-amnesia-hist",
+        build=build,
+        summarize=raft.sweep_summary,
+        num_nodes=cfg.num_nodes,
+        fault_kind=raft.K_FAULT,
+        node_of=lambda kind, pay: int(pay[0]),
+        violating=lambda final: violating_seeds(final, spec, screen=True),
+        hist_spec=spec,
+    )
+    from madsim_tpu.engine.faults import FaultSpec
+
+    ccfg = CampaignConfig(rounds=2, seeds_per_round=24, chunk_size=8)
+    bland = FaultSpec(
+        crashes=3, crash_window_ns=2_000_000_000,
+        restart_lo_ns=50_000_000, restart_hi_ns=300_000_000,
+    )
+    r1 = run_campaign(target, bland, ccfg)
+    r2 = run_campaign(target, bland, ccfg)
+    assert r1.records == r2.records
+    # the pipeline's screened verdicts == a direct screened check
+    wl, ecfg = build(bland)
+    final = ecore.run_sweep(wl, ecfg, jnp.arange(24, dtype=jnp.int64))
+    direct = violating_seeds(final, spec, screen=True)
+    assert r1.records[0]["violating_seeds"] == [int(s) for s in direct[:8]]
+
+
+def test_pipelined_ckpt_resume_is_bit_identical(tmp_path):
+    wl, ecfg = etcd.workload(ETCD_BUG), _ecfg(ETCD_BUG)
+    seeds = jnp.arange(40, dtype=jnp.int64)
+    spec = etcd.history_spec()
+    straight = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16
+    )
+    d = str(tmp_path / "ck")
+    partial = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16,
+        ckpt_dir=d, stop_after=1,
+    )
+    assert partial["seeds"] == 16
+    assert len(os.listdir(d)) == 1
+    resumed = checked_sweep(
+        wl, ecfg, seeds, spec, etcd.sweep_summary, chunk_size=16,
+        ckpt_dir=d,
+    )
+    assert resumed == straight
+    # a foreign directory (different seeds) must refuse, not merge
+    with pytest.raises(ValueError, match="different sweep"):
+        checked_sweep(
+            wl, ecfg, jnp.arange(100, 140, dtype=jnp.int64), spec,
+            etcd.sweep_summary, chunk_size=16, ckpt_dir=d,
+        )
+
+
+def test_inflight_checkpoint_resume_is_bit_identical(tmp_path):
+    """The recovery_e2e satellite: interrupt mid-chunk, checkpoint with
+    v7 inflight metadata, restore, resume with overlap enabled — the
+    merged checked-sweep report is bit-identical."""
+    wl = etcd.workload(ETCD_BUG)
+    full = _ecfg(ETCD_BUG)
+    short = _ecfg(ETCD_BUG, max_steps=300)
+    seeds = jnp.arange(32, dtype=jnp.int64)
+    spec = etcd.history_spec()
+    straight = checked_sweep(
+        wl, full, seeds, spec, etcd.sweep_summary, chunk_size=16
+    )
+    partial = ecore.run_sweep(wl, short, seeds[:16])
+    path = str(tmp_path / "mid.npz")
+    eckpt.save_sweep(partial, path, inflight={"lo": 0, "k": 16})
+    restored = eckpt.load_sweep(path, like=partial)
+    inflight = eckpt.load_inflight(path)
+    assert inflight == {"lo": 0, "k": 16}
+    resumed = checked_sweep(
+        wl, full, seeds, spec, etcd.sweep_summary, chunk_size=16,
+        resume_from=(restored, inflight),
+    )
+    assert resumed == straight
+    # a snapshot of the WRONG chunk's seeds must refuse
+    with pytest.raises(ValueError, match="resume_from"):
+        checked_sweep(
+            wl, full, jnp.arange(100, 132, dtype=jnp.int64), spec,
+            etcd.sweep_summary, chunk_size=16,
+            resume_from=(restored, inflight),
+        )
+    # ...and a plain snapshot carries no inflight metadata
+    plain = str(tmp_path / "plain.npz")
+    eckpt.save_sweep(partial, plain)
+    assert eckpt.load_inflight(plain) is None
