@@ -18,6 +18,11 @@
 #                      (spec, seed) grids incl. every gray-failure
 #                      family, outcome distributions within tolerances,
 #                      both tiers' histories checked by one spec
+#   make wire-smoke    heavy-traffic Kafka-binary-wire gate: concurrent
+#                      genuine-protocol clients (producers + a consumer
+#                      group) against the sim broker under a latency
+#                      burst, LogSpec-checked history, live-vs-replay
+#                      byte identity, plus a differential-fuzz sweep
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -38,8 +43,8 @@ PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
-	explore-smoke oracle-smoke differential-smoke dryrun bench-smoke \
-	test-all
+	explore-smoke oracle-smoke differential-smoke wire-smoke dryrun \
+	bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -68,7 +73,14 @@ oracle-smoke:
 differential-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/differential_demo.py
 
-stest: test determinism explore-smoke oracle-smoke differential-smoke
+# the kafka wire under concurrent genuine-protocol load + fuzz
+# (scripts/wire_load_demo.py docstring has the three determinism claims)
+wire-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/wire_load_demo.py
+	$(PY) scripts/wire_load_demo.py --fuzz 12
+
+stest: test determinism explore-smoke oracle-smoke differential-smoke \
+	wire-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -78,6 +90,7 @@ test-real:
 	  tests/test_real_grpcio.py tests/test_real_etcd.py \
 	  tests/test_real_kafka_s3.py tests/test_real_fs_signal.py \
 	  tests/test_etcd_wire.py tests/test_s3_wire.py \
+	  tests/test_kafka_wire.py tests/test_wire_differential.py \
 	  -q $(PYTEST_ARGS)
 
 test-procs:

@@ -330,6 +330,83 @@ service Maintenance {
 }
 """
 
+# etcd's election/lock "concurrency" services live in their own proto
+# packages (server/etcdserver/api/v3election, v3lock) and their own
+# files here — one .proto holds one package — importing the shared
+# header/KeyValue messages from the main schema.
+ELECTION_PROTO = """
+syntax = "proto3";
+package v3electionpb;
+
+import "etcd_wire.proto";
+
+message CampaignRequest {
+  bytes name = 1;
+  int64 lease = 2;
+  bytes value = 3;
+}
+
+message LeaderKey {
+  bytes name = 1;
+  bytes key = 2;
+  int64 rev = 3;
+  int64 lease = 4;
+}
+
+message CampaignResponse {
+  etcdserverpb.ResponseHeader header = 1;
+  LeaderKey leader = 2;
+}
+
+message LeaderRequest { bytes name = 1; }
+message LeaderResponse {
+  etcdserverpb.ResponseHeader header = 1;
+  etcdserverpb.KeyValue kv = 2;
+}
+
+message ProclaimRequest {
+  LeaderKey leader = 1;
+  bytes value = 2;
+}
+message ProclaimResponse { etcdserverpb.ResponseHeader header = 1; }
+
+message ResignRequest { LeaderKey leader = 1; }
+message ResignResponse { etcdserverpb.ResponseHeader header = 1; }
+
+service Election {
+  rpc Campaign (CampaignRequest) returns (CampaignResponse);
+  rpc Proclaim (ProclaimRequest) returns (ProclaimResponse);
+  rpc Leader (LeaderRequest) returns (LeaderResponse);
+  rpc Observe (LeaderRequest) returns (stream LeaderResponse);
+  rpc Resign (ResignRequest) returns (ResignResponse);
+}
+"""
+
+LOCK_PROTO = """
+syntax = "proto3";
+package v3lockpb;
+
+import "etcd_wire.proto";
+
+message LockRequest {
+  bytes name = 1;
+  int64 lease = 2;
+}
+
+message LockResponse {
+  etcdserverpb.ResponseHeader header = 1;
+  bytes key = 2;
+}
+
+message UnlockRequest { bytes key = 1; }
+message UnlockResponse { etcdserverpb.ResponseHeader header = 1; }
+
+service Lock {
+  rpc Lock (LockRequest) returns (LockResponse);
+  rpc Unlock (UnlockRequest) returns (UnlockResponse);
+}
+"""
+
 _pkg_cache: dict = {}
 
 
@@ -338,10 +415,17 @@ def wire_pkg() -> protogen.ProtoPackage:
     descriptor pool cannot hold two versions of one file)."""
     if "pkg" not in _pkg_cache:
         d = tempfile.mkdtemp(prefix="etcd_wire_proto")
-        path = os.path.join(d, "etcd_wire.proto")
-        with open(path, "w") as f:
-            f.write(ETCD_PROTO)
-        _pkg_cache["pkg"] = protogen.compile_protos(path)
+        paths = []
+        for name, text in (
+            ("etcd_wire.proto", ETCD_PROTO),
+            ("etcd_election.proto", ELECTION_PROTO),
+            ("etcd_lock.proto", LOCK_PROTO),
+        ):
+            path = os.path.join(d, name)
+            with open(path, "w") as f:
+                f.write(text)
+            paths.append(path)
+        _pkg_cache["pkg"] = protogen.compile_protos(*paths)
     return _pkg_cache["pkg"]
 
 
@@ -855,6 +939,110 @@ def _make_watch_service(pkg, svc: EtcdService):
     return WatchWire()
 
 
+async def acquire_candidacy(
+    svc: EtcdService, name: bytes, value: bytes, lease: int
+) -> bytes:
+    """The blocking half of Campaign/Lock: write our candidacy key and
+    wait until it is the OLDEST (lowest create_revision) under the
+    prefix. Subscribes BEFORE each try so a delete landing between the
+    try and the wait cannot be missed; only deletions (resign, unlock,
+    lease expiry) can change who is oldest, so only they wake the loop.
+    Module-level (not closed over a compiled proto package) so the
+    recipe's semantics are testable without protoc."""
+    from .service import EventType
+
+    while True:
+        watcher = svc.bus.subscribe(name + b"/", True)
+        try:
+            key = svc.campaign_try(name, value, lease)
+            if key is not None:
+                return key
+            while True:
+                ev = await watcher.next()
+                if ev.type == EventType.DELETE:
+                    break  # a candidate left — re-evaluate leadership
+        finally:
+            watcher.cancel()
+
+
+def _make_concurrency_services(pkg, svc: EtcdService):
+    """The v3election/v3lock "concurrency" services, on the exact recipe
+    real etcd's run on: a candidate key ``name + "/" + hex(lease)`` under
+    the election prefix, leadership to the LOWEST create_revision, and
+    blocking by watching the prefix for deletions (resign, unlock, or
+    lease expiry) before re-trying. ``EtcdService`` already holds the
+    primitives (campaign_try/election_leader/proclaim/resign,
+    service.rs:487-583); these classes put them on the wire."""
+    from ..grpc.status import Status
+    from .service import DeleteOptions
+
+    m = _mk_classes(pkg)
+
+    async def _acquire(name: bytes, value: bytes, lease: int) -> bytes:
+        return await acquire_candidacy(svc, name, value, lease)
+
+    @pkg.implement("v3electionpb.Election")
+    class ElectionWire:
+        async def campaign(self, request):
+            req = request.message
+            key = await _acquire(req.name, req.value, req.lease)
+            kv = svc.kv[key]
+            return m["CampaignResponse"](
+                header=_header(m, svc),
+                leader=m["LeaderKey"](
+                    name=req.name, key=key,
+                    rev=kv.create_revision, lease=req.lease,
+                ),
+            )
+
+        async def proclaim(self, request):
+            req = request.message
+            svc.proclaim(req.leader.key, req.value)  # gone key -> error
+            return m["ProclaimResponse"](header=_header(m, svc))
+
+        async def leader(self, request):
+            kv = svc.election_leader(request.message.name)
+            if kv is None:
+                raise Status.not_found("election: no leader")
+            return m["LeaderResponse"](
+                header=_header(m, svc), kv=_wire_kv(m, kv)
+            )
+
+        async def observe(self, request):
+            name = request.message.name
+            watcher = svc.bus.subscribe(name + b"/", True)
+            last = None
+            try:
+                while True:
+                    kv = svc.election_leader(name)
+                    if kv is not None and (kv.key, kv.mod_revision) != last:
+                        last = (kv.key, kv.mod_revision)
+                        yield m["LeaderResponse"](
+                            header=_header(m, svc), kv=_wire_kv(m, kv)
+                        )
+                    await watcher.next()
+            finally:
+                watcher.cancel()
+
+        async def resign(self, request):
+            # resigning a key that is already gone is a no-op, as in etcd
+            svc.resign(request.message.leader.key)
+            return m["ResignResponse"](header=_header(m, svc))
+
+    @pkg.implement("v3lockpb.Lock")
+    class LockWire:
+        async def lock(self, request):
+            req = request.message
+            key = await _acquire(req.name, b"", req.lease)
+            return m["LockResponse"](header=_header(m, svc), key=key)
+
+        async def unlock(self, request):
+            svc.delete(request.message.key, DeleteOptions())
+            return m["UnlockResponse"](header=_header(m, svc))
+
+    return ElectionWire(), LockWire()
+
+
 class WireServer:
     """Serve an :class:`EtcdService` over genuine etcd v3 gRPC wire
     (real mode: grpc.aio transport + wall-clock lease ticks)."""
@@ -876,12 +1064,15 @@ class WireServer:
         )
         pkg = wire_pkg()
         kv, lease = _make_services(pkg, self.service)
+        election, lock = _make_concurrency_services(pkg, self.service)
         router = (
             GrpcioServer.builder()
             .add_service(kv)
             .add_service(lease)
             .add_service(_make_watch_service(pkg, self.service))
             .add_service(_make_maintenance_service(pkg, self.service))
+            .add_service(election)
+            .add_service(lock)
         )
 
         async def tick_loop() -> None:

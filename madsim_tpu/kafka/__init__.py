@@ -13,6 +13,10 @@ served over Endpoint connections with a request enum
   ``BaseProducer`` (buffer until flush) / ``FutureProducer``,
   ``BaseConsumer`` (assign/seek/poll) / ``StreamConsumer``,
   ``AdminClient`` (create/delete topics)
+- :mod:`wire` — the GENUINE Kafka binary protocol (framing, headers,
+  record-batch v2 + CRC32C, full consumer-group API) serving the same
+  ``Broker`` on both tiers (docs/wire.md); :mod:`probe` is the vendored
+  wire client, :mod:`fuzz` the seeded wire-vs-broker differential
 """
 
 from .broker import OwnedMessage, Watermarks

@@ -237,6 +237,12 @@ class Broker:
         for t in topics:
             self._topic(t)  # unknown topics fail the join loudly
         g = self._group(group_id)
+        if member_id is not None and g.members.get(member_id) == list(topics):
+            # rejoin with an unchanged subscription: answer from the
+            # current generation instead of bumping it — the wire tier's
+            # heartbeat-triggered rejoins (REBALANCE_IN_PROGRESS -> Join/
+            # Sync) must converge, not storm every other member forever
+            return member_id, g.generation, g.assignments.get(member_id, [])
         if member_id is None:
             member_id = f"member-{g.next_member}"
             g.next_member += 1

@@ -49,6 +49,32 @@ def encode_frame(body: bytes) -> bytes:
     return _LEN.pack(len(body)) + body
 
 
+async def read_frame_raw(reader: asyncio.StreamReader) -> Optional[bytes]:
+    """Read one raw length-prefixed frame body (no codec): ``None`` on
+    clean EOF at a frame boundary, ``ConnectionResetError`` mid-frame.
+    The Kafka binary wire (kafka/wire.py) uses exactly this framing, so
+    its real tier reads genuine protocol bytes through the same rules."""
+    try:
+        head = await reader.readexactly(_LEN.size)
+    except asyncio.IncompleteReadError as e:
+        if e.partial:
+            raise ConnectionResetError("truncated frame") from None
+        return None
+    (n,) = _LEN.unpack(head)
+    if n > _MAX_FRAME:
+        raise ConnectionResetError(f"frame of {n} bytes exceeds sanity bound")
+    try:
+        return await reader.readexactly(n)
+    except asyncio.IncompleteReadError:
+        raise ConnectionResetError("truncated frame") from None
+
+
+async def write_frame_raw(writer: asyncio.StreamWriter, body: bytes) -> None:
+    """Write one raw length-prefixed frame body (no codec) and drain."""
+    writer.write(encode_frame(body))
+    await writer.drain()
+
+
 def parse_addr(addr: "str | Addr") -> Addr:
     if isinstance(addr, tuple):
         return (addr[0], int(addr[1]))

@@ -126,6 +126,32 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     exit 1
   fi
 
+  # wire leg (docs/wire.md): the Kafka-binary-wire load report and the
+  # wire differential-fuzz report must each be byte-identical across two
+  # processes; each load run ALSO asserts the second path in-process —
+  # the live sim serve vs a recorded-(frame, clock) replay through a
+  # fresh broker must agree byte for byte (replay_ok in the report).
+  # || true: a demo failure must fall through to the diagnostic branch
+  # below (set -e would otherwise abort with the logs unprinted)
+  for r in wa wb; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/wire_load_demo.py \
+      --report "$out/$r.json" >"$out/$r.log" 2>&1 || true
+  done
+  for r in wfa wfb; do
+    "${PY:-python}" scripts/wire_load_demo.py --fuzz 8 \
+      --report "$out/$r.json" >"$out/$r.log" 2>&1 || true
+  done
+  if [ -s "$out/wa.json" ] && cmp -s "$out/wa.json" "$out/wb.json" \
+    && [ -s "$out/wfa.json" ] && cmp -s "$out/wfa.json" "$out/wfb.json"; then
+    echo "determinism gate: OK (wire load + fuzz, 2 processes x 2 paths, byte-identical)"
+  else
+    echo "determinism gate: FAILED — wire load/fuzz reports differ or are empty" >&2
+    diff "$out/wa.json" "$out/wb.json" >&2 || true
+    diff "$out/wfa.json" "$out/wfb.json" >&2 || true
+    cat "$out"/w*.log >&2 || true
+    exit 1
+  fi
+
   # differential leg: the host<->device differential report
   # (docs/faults.md gray failures) must be byte-identical across two
   # processes — a small matched grid here; the full 200-seed tolerance

@@ -587,3 +587,208 @@ def test_wire_lease_expires_on_wall_clock():
         task.abort()
 
     real.Runtime().block_on(main())
+
+
+# -- election / lock (v3electionpb.Election, v3lockpb.Lock) ------------------
+
+import shutil  # noqa: E402
+
+needs_protoc = pytest.mark.skipif(
+    shutil.which("protoc") is None,
+    reason="protoc not installed (environmental — see BASELINE notes)",
+)
+
+
+@needs_protoc
+def test_wire_election_campaign_proclaim_leader_resign():
+    """The v3election service over genuine gRPC: campaign wins with a
+    live lease, Leader observes the proclaimed value, a second candidate
+    blocks until the first resigns, and Proclaim after resign fails by
+    name (session expired)."""
+    import asyncio
+
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+
+            def emc(method, req_cls, rsp_cls):
+                return ch.unary_unary(
+                    f"/v3electionpb.Election/{method}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=rsp_cls.FromString,
+                )
+
+            campaign = emc("Campaign", m["CampaignRequest"],
+                           m["CampaignResponse"])
+            proclaim = emc("Proclaim", m["ProclaimRequest"],
+                           m["ProclaimResponse"])
+            leader_mc = emc("Leader", m["LeaderRequest"], m["LeaderResponse"])
+            resign = emc("Resign", m["ResignRequest"], m["ResignResponse"])
+
+            l1 = (await grant(m["LeaseGrantRequest"](TTL=60))).ID
+            l2 = (await grant(m["LeaseGrantRequest"](TTL=60))).ID
+
+            r1 = await campaign(m["CampaignRequest"](
+                name=b"elec", lease=l1, value=b"alpha"
+            ))
+            key1 = r1.leader.key
+            assert key1.startswith(b"elec/") and r1.leader.rev > 0
+
+            # Leader sees the current value; Proclaim replaces it
+            led = await leader_mc(m["LeaderRequest"](name=b"elec"))
+            assert led.kv.key == key1 and led.kv.value == b"alpha"
+            await proclaim(m["ProclaimRequest"](
+                leader=r1.leader, value=b"alpha-2"
+            ))
+            led = await leader_mc(m["LeaderRequest"](name=b"elec"))
+            assert led.kv.value == b"alpha-2"
+
+            # a second candidate BLOCKS until the first resigns
+            second = asyncio.ensure_future(campaign(m["CampaignRequest"](
+                name=b"elec", lease=l2, value=b"beta"
+            )))
+            await real.sleep(0.1)
+            assert not second.done()  # still parked behind the leader
+            await resign(m["ResignRequest"](leader=r1.leader))
+            r2 = await asyncio.wait_for(second, timeout=5)
+            assert r2.leader.key != key1
+            led = await leader_mc(m["LeaderRequest"](name=b"elec"))
+            assert led.kv.value == b"beta"
+
+            # proclaiming with the RESIGNED leader key fails by name
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await proclaim(m["ProclaimRequest"](
+                    leader=r1.leader, value=b"zombie"
+                ))
+            assert e.value.code() == grpcio.StatusCode.FAILED_PRECONDITION
+
+            # no-leader elections answer NOT_FOUND
+            with pytest.raises(grpc_aio.AioRpcError) as e:
+                await leader_mc(m["LeaderRequest"](name=b"empty"))
+            assert e.value.code() == grpcio.StatusCode.NOT_FOUND
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+@needs_protoc
+def test_wire_lock_blocks_until_unlock_and_lease_expiry():
+    """The v3lock service: Lock hands out the key immediately when free,
+    a contender blocks until Unlock, and revoking the holder's lease
+    releases the lock to the waiter (the session-expiry path)."""
+    import asyncio
+
+    m = _msgs()
+
+    async def main():
+        _server, task, addr = await _start()
+        async with grpc_aio.insecure_channel(addr) as ch:
+            grant = _mc(ch, m, "Lease", "LeaseGrant",
+                        m["LeaseGrantRequest"], m["LeaseGrantResponse"])
+            revoke = _mc(ch, m, "Lease", "LeaseRevoke",
+                         m["LeaseRevokeRequest"], m["LeaseRevokeResponse"])
+
+            def lmc(method, req_cls, rsp_cls):
+                return ch.unary_unary(
+                    f"/v3lockpb.Lock/{method}",
+                    request_serializer=req_cls.SerializeToString,
+                    response_deserializer=rsp_cls.FromString,
+                )
+
+            lock = lmc("Lock", m["LockRequest"], m["LockResponse"])
+            unlock = lmc("Unlock", m["UnlockRequest"], m["UnlockResponse"])
+
+            l1 = (await grant(m["LeaseGrantRequest"](TTL=60))).ID
+            l2 = (await grant(m["LeaseGrantRequest"](TTL=60))).ID
+            l3 = (await grant(m["LeaseGrantRequest"](TTL=60))).ID
+
+            r1 = await lock(m["LockRequest"](name=b"mtx", lease=l1))
+            assert r1.key.startswith(b"mtx/")
+
+            waiter = asyncio.ensure_future(
+                lock(m["LockRequest"](name=b"mtx", lease=l2))
+            )
+            await real.sleep(0.1)
+            assert not waiter.done()
+            await unlock(m["UnlockRequest"](key=r1.key))
+            r2 = await asyncio.wait_for(waiter, timeout=5)
+            assert r2.key != r1.key
+
+            # lease revocation (session expiry) also releases the lock
+            waiter3 = asyncio.ensure_future(
+                lock(m["LockRequest"](name=b"mtx", lease=l3))
+            )
+            await real.sleep(0.1)
+            assert not waiter3.done()
+            await revoke(m["LeaseRevokeRequest"](ID=l2))
+            r3 = await asyncio.wait_for(waiter3, timeout=5)
+            assert r3.key.startswith(b"mtx/")
+        task.abort()
+
+    real.Runtime().block_on(main())
+
+
+# -- the acquire recipe, protoc-free (pure EtcdService + asyncio) -----------
+# The wire services above are thin shells around acquire_candidacy + the
+# existing service primitives; these tests pin the recipe's semantics in
+# environments without protoc (this container included).
+
+
+def test_acquire_candidacy_blocks_and_hands_off_in_revision_order():
+    import asyncio
+
+    from madsim_tpu.etcd.service import DeleteOptions, EtcdService
+
+    svc = EtcdService()
+
+    async def main():
+        svc.bus.future_factory = (
+            lambda: asyncio.get_running_loop().create_future()
+        )
+        l1, _ = svc.lease_grant(60)
+        l2, _ = svc.lease_grant(60)
+        l3, _ = svc.lease_grant(60)
+
+        key1 = await wire.acquire_candidacy(svc, b"e", b"one", l1)
+        assert svc.election_leader(b"e").key == key1
+
+        # two waiters queue up; handoff is oldest-candidacy-first
+        w2 = asyncio.ensure_future(
+            wire.acquire_candidacy(svc, b"e", b"two", l2)
+        )
+        await asyncio.sleep(0.01)
+        w3 = asyncio.ensure_future(
+            wire.acquire_candidacy(svc, b"e", b"three", l3)
+        )
+        await asyncio.sleep(0.01)
+        assert not w2.done() and not w3.done()
+
+        svc.delete(key1, DeleteOptions())  # resign
+        key2 = await asyncio.wait_for(w2, timeout=5)
+        assert svc.election_leader(b"e").key == key2
+        assert not w3.done()  # strictly one handoff per release
+
+        svc.lease_revoke(l2)  # session expiry releases too
+        key3 = await asyncio.wait_for(w3, timeout=5)
+        assert svc.election_leader(b"e").key == key3
+
+    asyncio.run(main())
+
+
+def test_acquire_candidacy_requires_live_lease():
+    import asyncio
+
+    from madsim_tpu.etcd.service import EtcdService
+    from madsim_tpu.grpc.status import Status
+
+    svc = EtcdService()
+
+    async def main():
+        with pytest.raises(Status):
+            await wire.acquire_candidacy(svc, b"e", b"x", 424242)
+
+    asyncio.run(main())
