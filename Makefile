@@ -23,13 +23,19 @@
 #                      group) against the sim broker under a latency
 #                      burst, LogSpec-checked history, live-vs-replay
 #                      byte identity, plus a differential-fuzz sweep
+#   make multichip-smoke
+#                      sharded checked-sweep pipeline on the CPU host
+#                      mesh: device-count curve + a small sharded
+#                      campaign, summary/report bytes asserted
+#                      identical across mesh sizes
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
 #                      plus two history decodes, bytes diffed; plus the
 #                      pipelined checked-sweep report across two
-#                      processes x two worker-pool sizes, byte-diffed)
-#                      + explore-smoke + oracle-smoke
+#                      processes x two worker-pool sizes AND two mesh
+#                      sizes, byte-diffed)
+#                      + explore-smoke + oracle-smoke + multichip-smoke
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality
 #   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
@@ -43,8 +49,8 @@ PYTEST ?= $(PY) -m pytest
 PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
-	explore-smoke oracle-smoke differential-smoke wire-smoke dryrun \
-	bench-smoke test-all
+	explore-smoke oracle-smoke differential-smoke wire-smoke \
+	multichip-smoke dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -79,8 +85,14 @@ wire-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/wire_load_demo.py
 	$(PY) scripts/wire_load_demo.py --fuzz 12
 
+# the sharded checked-sweep pipeline on the CPU host mesh
+# (docs/multichip.md): device-count curve + small campaign, bytes
+# asserted identical across mesh sizes
+multichip-smoke:
+	$(PY) scripts/multichip_campaign.py --smoke
+
 stest: test determinism explore-smoke oracle-smoke differential-smoke \
-	wire-smoke
+	wire-smoke multichip-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

@@ -39,6 +39,51 @@ def force_cpu_mesh_env(env: MutableMapping[str, str], n_devices: int) -> None:
     env["XLA_FLAGS"] = flags
 
 
+def reexec_with_cpu_mesh(n_devices: int) -> None:
+    """Re-exec ``sys.argv`` under the forced CPU mesh when this process
+    sees fewer than ``n_devices`` devices (or its backend fails to
+    init); no-op when enough devices already exist.
+
+    The multi-device demo scripts (scripts/multichip_campaign.py,
+    checked_sweep_demo --mesh, sweep_million --mesh) call this first
+    thing in ``main``: env vars alone are too late once jax has picked
+    a backend, so the script restarts itself in a child with the env
+    fixed and exits with the child's code. The marker env var stops a
+    child that STILL lacks devices from recursing."""
+    import os
+    import subprocess
+    import sys
+
+    if os.environ.get("_MADSIM_MESH_REEXEC") == "1":
+        import jax
+
+        have = len(jax.devices())
+        if have < n_devices:
+            # don't return silently: callers would shard over fewer
+            # devices than they report (and recursing can't help)
+            raise RuntimeError(
+                f"re-exec'd under the forced CPU mesh but still see "
+                f"{have} < {n_devices} devices — is something clobbering "
+                "XLA_FLAGS/JAX_PLATFORMS in this environment?"
+            )
+        return
+    have = 0
+    try:
+        import jax
+
+        have = len(jax.devices())
+    except Exception:
+        have = 0  # backend init failed; the CPU-mesh child still works
+    if have >= n_devices:
+        return
+    env = dict(os.environ)
+    env["_MADSIM_MESH_REEXEC"] = "1"
+    force_cpu_mesh_env(env, n_devices)
+    raise SystemExit(
+        subprocess.run([sys.executable] + sys.argv, env=env).returncode
+    )
+
+
 def apply_in_process() -> None:
     """Force the cpu platform even if jax was already imported.
 

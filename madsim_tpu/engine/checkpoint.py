@@ -39,12 +39,25 @@ from .core import EngineConfig, EngineState, Workload
 #     snapshot, double-counting completed chunks — which is why v6
 #     REJECTS v7, while this reader still ACCEPTS v6 files (the leaf
 #     layout is unchanged; an old snapshot simply has no inflight tag).
-_FORMAT_VERSION = 7
-_READABLE_VERSIONS = (6, 7)
+# v8: mesh-sharded pipelined sweeps — a snapshot may carry
+#     ``__mesh_layout__`` (device count + per-device chunk of the
+#     sharded driver, ``parallel.mesh.mesh_layout``), so a sweep
+#     interrupted on an 8-device mesh resumes on ANY device count with
+#     the same GLOBAL chunk boundaries (``chunk_size`` rides in the
+#     metadata; the state arrays themselves are layout-free host data).
+#     v7 readers would drop the layout and could resume with mismatched
+#     chunk granules — their per-chunk files silently never matching —
+#     hence the bump; this reader still ACCEPTS v6/v7 files (the leaf
+#     layout is unchanged; an old snapshot simply has no mesh tag).
+_FORMAT_VERSION = 8
+_READABLE_VERSIONS = (6, 7, 8)
 
 
 def save_sweep(
-    state: EngineState, path: str, inflight: Optional[dict] = None
+    state: EngineState,
+    path: str,
+    inflight: Optional[dict] = None,
+    mesh_layout: Optional[dict] = None,
 ) -> None:
     """Serialize a batched EngineState to ``path`` (.npz).
 
@@ -52,7 +65,11 @@ def save_sweep(
     IN-FLIGHT CHUNK of a pipelined sweep — at least ``{"lo": <chunk
     start index>, "k": <real lanes>}`` — so ``run_sweep_pipelined``
     can resume mid-chunk (``resume_from``) instead of restarting the
-    chunk; read it back with ``load_inflight``."""
+    chunk; read it back with ``load_inflight``. ``mesh_layout``
+    (JSON-able dict, format v8 — ``parallel.mesh.mesh_layout``) records
+    the sharded driver's device count and chunk sizing so a different-
+    sized mesh resumes with identical global chunk boundaries; read it
+    back with ``load_mesh_layout``."""
     import json
 
     leaves, treedef = jax.tree.flatten(state)
@@ -63,21 +80,36 @@ def save_sweep(
             arrays[f"leaf_{i}__key"] = np.asarray(jax.random.key_data(leaf))
         else:
             arrays[f"leaf_{i}"] = np.asarray(leaf)
-    if inflight is not None:
-        arrays["__inflight__"] = np.frombuffer(
-            json.dumps(inflight, sort_keys=True).encode(), dtype=np.uint8
-        )
+    for name, meta in (
+        ("__inflight__", inflight), ("__mesh_layout__", mesh_layout)
+    ):
+        if meta is not None:
+            arrays[name] = np.frombuffer(
+                json.dumps(meta, sort_keys=True).encode(), dtype=np.uint8
+            )
     np.savez_compressed(path, __version__=_FORMAT_VERSION, **arrays)
 
 
-def load_inflight(path: str) -> Optional[dict]:
-    """The ``inflight`` chunk metadata of a v7 snapshot, or None."""
+def _load_meta(path: str, name: str) -> Optional[dict]:
     import json
 
     data = np.load(path)
-    if "__inflight__" not in data:
+    if name not in data:
         return None
-    return json.loads(bytes(bytearray(data["__inflight__"])).decode())
+    return json.loads(bytes(bytearray(data[name])).decode())
+
+
+def load_inflight(path: str) -> Optional[dict]:
+    """The ``inflight`` chunk metadata of a v7+ snapshot, or None."""
+    return _load_meta(path, "__inflight__")
+
+
+def load_mesh_layout(path: str) -> Optional[dict]:
+    """The mesh-layout metadata of a v8 snapshot, or None (an unsharded
+    or pre-v8 snapshot). Resuming callers that honor
+    ``layout["chunk_size"]`` keep per-chunk checkpoint files aligned
+    across device counts."""
+    return _load_meta(path, "__mesh_layout__")
 
 
 def load_sweep(path: str, like: EngineState) -> EngineState:
@@ -180,6 +212,7 @@ def run_sweep_chunked_resumable(
     summarize,
     ckpt_dir: str,
     chunk_size: int = 16384,
+    run_chunk: Optional[Callable] = None,
 ) -> dict:
     """Pod-scale sweep that survives interruption at chunk granularity.
 
@@ -197,12 +230,20 @@ def run_sweep_chunked_resumable(
     engine config; a mismatch (the directory belongs to a different
     sweep) raises instead of silently merging foreign counts. For mid-chunk snapshots of in-flight state
     use ``save_sweep``/``resume_sweep`` instead.
+
+    ``run_chunk(seed_chunk) -> final state`` overrides the per-chunk
+    sweep — the mesh driver injects ``parallel.run_sweep_sharded`` here
+    (scripts/sweep_million.py ``--mesh``); the chunk files it writes are
+    mesh-free (fingerprint + seed sha only), so a sweep can be
+    interrupted under one device count and finished under another.
     """
     import os
 
     from .core import _concat_finals, _pad_seeds, run_sweep
     from ..models._common import merge_summaries  # lazy: models import us
 
+    if run_chunk is None:
+        run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
     seeds = jnp.asarray(seeds, jnp.int64)
     seeds_host = np.asarray(seeds)  # bookkeeping reads skip the device
     n = int(seeds.shape[0])
@@ -230,9 +271,7 @@ def run_sweep_chunked_resumable(
             # k-shaped trim program
             chunk = seeds[lo : lo + chunk_size]
             pad = chunk_size - k
-            final = run_sweep(
-                workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
-            )
+            final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
             if pad and getattr(summarize, "supports_limit", False):
                 summary = summarize(final, limit=k)
             else:
@@ -256,6 +295,10 @@ def run_sweep_pipelined(
     ckpt_dir: Optional[str] = None,
     stop_after: Optional[int] = None,
     resume_from: Optional[Tuple[EngineState, dict]] = None,
+    run_chunk: Optional[Callable] = None,
+    resume_chunk: Optional[Callable] = None,
+    pad_multiple: int = 1,
+    on_chunk: Optional[Callable] = None,
 ) -> dict:
     """Chunked sweep with the host phase of chunk N overlapped against
     the device sweep of chunk N+1 — the driver that makes END-TO-END
@@ -301,12 +344,28 @@ def run_sweep_pipelined(
     overlap, and ``host_work`` must be a pure function of its chunk (the
     oracle's screened checker is), so the merged totals are byte-stable
     across pipelining, worker-pool sizes, and interruption points.
+
+    Scale-out hooks (``parallel.mesh.run_sweep_sharded_pipelined`` is
+    the canonical injector): ``run_chunk(seed_chunk) -> final`` replaces
+    the per-chunk sweep and ``resume_chunk(state) -> final`` the
+    mid-chunk resume drive — the mesh driver passes the sharded sweep
+    for both, so the identical pipeline spans 1 or N devices.
+    ``pad_multiple`` pads a batch smaller than one chunk up to the next
+    multiple (mesh divisibility) instead of not at all; the limit-masked
+    summary and trimmed host phase treat that pad exactly like a ragged
+    final chunk's. ``on_chunk(lo=, k=, summary=)`` fires as each chunk's
+    summary is merged (in seed order) — progress reporting and
+    time-to-first-violation measurement at the million-seed scale.
     """
     import os
 
     from .core import _concat_finals, _pad_seeds, run_sweep, _drive
     from ..models._common import merge_summaries  # lazy: models import us
 
+    if run_chunk is None:
+        run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
+    if resume_chunk is None:
+        resume_chunk = lambda state: _drive(workload, cfg, state)  # noqa: E731
     seeds = jnp.asarray(seeds, jnp.int64)
     seeds_host = np.asarray(seeds)
     n = int(seeds.shape[0])
@@ -343,6 +402,8 @@ def run_sweep_pipelined(
                 sha, fp, summary,
             )
         merge_summaries(totals, summary)
+        if on_chunk is not None:
+            on_chunk(lo=lo, k=k, summary=summary)
 
     for lo in range(0, n, chunk_size):
         k = min(chunk_size, n - lo)
@@ -361,10 +422,12 @@ def run_sweep_pipelined(
                 flush(pending)  # keep merge order = seed order
                 pending = None
             merge_summaries(totals, summary)
+            if on_chunk is not None:
+                on_chunk(lo=lo, k=k, summary=summary)
             continue
 
         # -- device phase: enqueue this chunk's sweep (+ screen) --------
-        pad = chunk_size - k if n > chunk_size else 0
+        pad = chunk_size - k if n > chunk_size else -k % pad_multiple
         if lo == resume_lo:
             state, inflight = resume_from
             if int(inflight.get("k", k)) != k or not np.array_equal(
@@ -374,12 +437,15 @@ def run_sweep_pipelined(
                     f"resume_from snapshot does not match chunk at {lo}: "
                     f"inflight={inflight!r}"
                 )
-            final = _drive(workload, cfg, state)
+            # the snapshot carries its OWN padding (the saving process's
+            # pad_multiple may differ across mesh sizes) — trust its lane
+            # count, not this process's pad, so the limit mask/trim below
+            # still hides exactly the synthetic lanes
+            pad = int(state.seed.shape[0]) - k
+            final = resume_chunk(state)
         else:
             chunk = seeds[lo : lo + chunk_size]
-            final = run_sweep(
-                workload, cfg, _pad_seeds(chunk, pad) if pad else chunk
-            )
+            final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
         susp = screen(final) if screen is not None else None
 
         # -- previous chunk's host phase overlaps this chunk's sweep ----

@@ -21,6 +21,9 @@ Testing*):
   next exploration round.
 - ``targets`` — the model adapters a campaign explores (the canonical
   one: the amnesia Raft config, ``replay.amnesia_raft_config``).
+- ``fleet`` — fleet scale: device-count throughput/time-to-first-bug
+  curves and million-seed campaigns routed through the sharded
+  pipelined driver (``parallel.mesh``; see ``docs/multichip.md``).
 - ``differential`` — host↔device differential validation: run the
   device raft model and ``examples/raft_host.py`` over matched
   ``(spec, seed)`` grids (one compiled fault schedule drives both
@@ -40,6 +43,7 @@ from .campaign import (  # noqa: F401
     spec_from_dict,
     spec_to_dict,
 )
+from .fleet import checked_sweep_curve, sharded_campaign  # noqa: F401
 from .differential import (  # noqa: F401
     DifferentialConfig,
     TierOutcome,

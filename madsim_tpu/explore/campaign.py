@@ -79,7 +79,13 @@ _SCALES = ((1, 2), (2, 3), (3, 2), (2, 1))
 
 
 class CampaignConfig(NamedTuple):
-    """Static campaign parameters (hashable, reprs stably)."""
+    """Static campaign parameters (hashable, reprs stably).
+
+    ``check_workers`` fans the history checker of a screened target over
+    a process pool (``oracle.check.check_histories``) — wall-clock only,
+    never a report byte, so it is safe to vary per machine. The report
+    HEADER records the whole config, so compare reports only across runs
+    of one config (the determinism gates do)."""
 
     rounds: int = 12
     seeds_per_round: int = 256
@@ -89,6 +95,7 @@ class CampaignConfig(NamedTuple):
     mutations_hi: int = 2  # 1..hi mutations per candidate
     stop_after_failures: int = 0  # stop once this many seeds violate (0 = never)
     max_recorded_seeds: int = 8  # violating seeds listed per round record
+    check_workers: int = 0  # process-pool size for history checking
 
 
 class CampaignResult(NamedTuple):
@@ -202,9 +209,13 @@ def _sweep_candidate(
     spec,
     ccfg: CampaignConfig,
     round_dir: Optional[str],
+    mesh=None,
+    on_chunk=None,
 ) -> dict:
     """Run one candidate's sweep over the pinned seed range; returns the
-    merged summary dict (coverage_map + violating_seeds included)."""
+    merged summary dict (coverage_map + violating_seeds included).
+    ``mesh`` shards the whole pipeline (sweep, screen, summary) over the
+    device mesh; the summary bytes are mesh-size-invariant."""
     workload, ecfg = target.build(spec)
     if workload.cover is None or workload.cover_bits == 0:
         raise ValueError(
@@ -231,7 +242,7 @@ def _sweep_candidate(
 
         if screen_for(target.hist_spec) is not None:
             def screen_fn(final):
-                return screen_sweep(final, target.hist_spec)
+                return screen_sweep(final, target.hist_spec, mesh=mesh)
 
     def host_work(final, *, lo, n, seeds, suspect, summary) -> dict:
         # the expensive half — checking may run the WGL search per
@@ -244,7 +255,8 @@ def _sweep_candidate(
             from ..oracle.check import violating_seeds
 
             vio = violating_seeds(
-                final, target.hist_spec, screen=lambda _f: suspect
+                final, target.hist_spec, screen=lambda _f: suspect,
+                workers=ccfg.check_workers,
             )
         else:
             vio = np.asarray(target.violating(final))
@@ -260,12 +272,23 @@ def _sweep_candidate(
     # one driver for both legs: with round_dir the per-chunk summaries
     # checkpoint (a restarted campaign regenerates the same candidate —
     # pure function of campaign_seed — and skips finished chunks);
-    # without it the pipeline still overlaps checking with sweeping
+    # without it the pipeline still overlaps checking with sweeping.
+    # A mesh lifts the same pipeline onto all devices — sharded sweep +
+    # screen + summary, identical report bytes on any mesh size.
+    if mesh is not None:
+        from ..parallel.mesh import run_sweep_sharded_pipelined
+
+        return run_sweep_sharded_pipelined(
+            workload, ecfg, seeds, target.summarize, mesh=mesh,
+            host_work=host_work, screen=screen_fn, chunk_size=chunk_size,
+            ckpt_dir=round_dir, on_chunk=on_chunk,
+        )
     from ..engine.checkpoint import run_sweep_pipelined
 
     return run_sweep_pipelined(
         workload, ecfg, seeds, target.summarize, host_work=host_work,
         screen=screen_fn, chunk_size=chunk_size, ckpt_dir=round_dir,
+        on_chunk=on_chunk,
     )
 
 
@@ -275,6 +298,8 @@ def run_campaign(
     ccfg: CampaignConfig = CampaignConfig(),
     report_path: Optional[str] = None,
     ckpt_dir: Optional[str] = None,
+    mesh=None,
+    on_chunk=None,
 ) -> CampaignResult:
     """Drive the find loop: ``rounds`` candidates from ``base_spec``.
 
@@ -287,7 +312,15 @@ def run_campaign(
     ``report_path`` writes one JSONL record per executed round (plus a
     header) — deterministic bytes per campaign seed. ``ckpt_dir`` makes
     each round's sweep preemption-safe via per-chunk summary checkpoints
-    (``engine/checkpoint.py``)."""
+    (``engine/checkpoint.py``).
+
+    ``mesh`` runs every round's checked sweep sharded over the device
+    mesh (``parallel.run_sweep_sharded_pipelined``) — the million-seed
+    configuration: per-round seed ranges in the tens of thousands, the
+    whole campaign one unit of work spanning all chips, and the JSONL
+    report BYTE-IDENTICAL to the same campaign on any other mesh size
+    (docs/multichip.md). ``on_chunk(lo=, k=, summary=)`` fires per
+    merged chunk (time-to-first-violation instrumentation)."""
     import os
 
     rng = random.Random(ccfg.campaign_seed)
@@ -316,7 +349,9 @@ def run_campaign(
         round_dir = (
             os.path.join(ckpt_dir, f"round_{r:04d}") if ckpt_dir else None
         )
-        summary = _sweep_candidate(target, spec, ccfg, round_dir)
+        summary = _sweep_candidate(
+            target, spec, ccfg, round_dir, mesh=mesh, on_chunk=on_chunk
+        )
 
         cand_map = [int(w) for w in summary.get("coverage_map", [])]
         if len(global_map) < len(cand_map):

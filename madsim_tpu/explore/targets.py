@@ -43,16 +43,30 @@ class Target(NamedTuple):
 
 
 def amnesia_raft_target(
-    time_limit_ns: int = 3_000_000_000, max_steps: int = 30_000
+    time_limit_ns: int = 3_000_000_000,
+    max_steps: int = 30_000,
+    hist_slots: int = 0,
 ) -> Target:
     """The canonical explore target: the 3-node amnesia Raft cluster of
     ``replay.amnesia_raft_config()`` — crash wipes durable state, so the
     election-safety detector (``V_ELECTION``) can actually fire — with
-    the fault campaign left OPEN for the explore loop to choose."""
+    the fault campaign left OPEN for the explore loop to choose.
+
+    ``hist_slots > 0`` turns on election-history recording and the
+    oracle leg: the target gains ``hist_spec``
+    (``oracle.specs.ElectionSpec``), so campaigns run the device-side
+    election screen behind every chunk and the checker over the suspect
+    lanes — the coverage-guided + history-checked configuration the
+    sharded million-seed campaign sweeps (``explore.fleet``).
+    "Violating" stays the model's latched flag either way: for raft the
+    election screen is PRECISE (== ``ElectionSpec.structural``), so the
+    two signals agree seed for seed (asserted in tests/test_oracle.py)."""
     from ..models import raft
     from ..replay import amnesia_raft_config, violation_seeds
 
     base_cfg, _ = amnesia_raft_config()
+    if hist_slots:
+        base_cfg = base_cfg._replace(hist_slots=hist_slots)
 
     def build(faults) -> Tuple[Workload, EngineConfig]:
         cfg = base_cfg._replace(faults=faults)
@@ -72,7 +86,29 @@ def amnesia_raft_target(
         fault_kind=raft.K_FAULT,
         node_of=node_of,
         violating=violation_seeds,
+        hist_spec=raft.history_spec() if hist_slots else None,
     )
+
+
+# the (target, base FaultSpec) pair the multichip gates sweep — ONE
+# definition shared by the __graft_entry__ dryrun curve and
+# scripts/multichip_campaign.py, so retuning the gate spec (e.g. a
+# crash-window change that keeps violations > 0) retunes every gate
+def amnesia_gate(smoke: bool = True):
+    from ..engine.faults import FaultSpec
+
+    target = amnesia_raft_target(
+        time_limit_ns=1_500_000_000 if smoke else 3_000_000_000,
+        max_steps=15_000 if smoke else 30_000,
+        hist_slots=16,
+    )
+    base = FaultSpec(
+        crashes=3,
+        crash_window_ns=1_200_000_000 if smoke else 2_000_000_000,
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=300_000_000,
+    )
+    return target, base
 
 
 # the fault environment the history-oracle pipeline runs under — ONE

@@ -135,13 +135,21 @@ def make_sweep_summary(
         # coverage union rides in the same program/transfer: OR the
         # per-seed bitmaps down the batch axis — the "one extra
         # reduction" that turns the engine's in-loop signal into a
-        # chunk-level coverage map (explore/campaign.py feeds on it)
+        # chunk-level coverage map (explore/campaign.py feeds on it).
+        # NOT lax.reduce with a bitwise_or combiner: when the batch axis
+        # is sharded over a mesh (parallel/mesh.py), GSPMD turns the
+        # lane reduction into a cross-device all-reduce, and the CPU
+        # runtime only implements the stock combiners (add/min/max) for
+        # it — so the OR is decomposed into 32 bit-planes reduced by
+        # MAX (identical words: the planes are disjoint, so the
+        # recombining sum IS the or), which partitions on every backend.
         cover = final.cover
         if m is not None:
             cover = jnp.where(m[:, None], cover, jnp.uint32(0))
-        union = jax.lax.reduce(
-            cover, jnp.uint32(0), jax.lax.bitwise_or, (0,)
-        )
+        shifts = jnp.arange(32, dtype=jnp.uint32)
+        bits = (cover[:, :, None] >> shifts) & jnp.uint32(1)  # [S, W, 32]
+        union = jnp.sum(jnp.max(bits, axis=0) << shifts, axis=1,
+                        dtype=jnp.uint32)
         return jnp.stack(cols), union
 
     _summarize = jax.jit(lambda final: _reduce(final, None))

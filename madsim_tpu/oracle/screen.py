@@ -262,6 +262,39 @@ def _batched(name: str):
     return jax.jit(jax.vmap(_SCREENS[name]))
 
 
+@lru_cache(maxsize=None)
+def _batched_sharded(name: str, mesh, block: int):
+    """The per-seed screen shard_map'd over the mesh's seed axis: each
+    device screens its LOCAL lanes (in ``block``-lane sub-batches, same
+    [block, H, H] working-set bound as the unsharded path), so a chunk's
+    screen program runs distributed right behind its sharded sweep with
+    no cross-device traffic at all — the suspect mask stays sharded
+    like the history planes it reduces. Cached per (spec, mesh, block):
+    a fresh shard_map wrapper per chunk would retrace every call."""
+    from jax.sharding import PartitionSpec as P
+
+    from ..parallel.mesh import SEED_AXIS, shard_map_compat
+
+    f = jax.vmap(_SCREENS[name])
+
+    def local(rec, t, n):
+        s = rec.shape[0]
+        if s <= block:
+            return f(rec, t, n)
+        return jnp.concatenate(
+            [
+                f(rec[lo : lo + block], t[lo : lo + block], n[lo : lo + block])
+                for lo in range(0, s, block)
+            ]
+        )
+
+    return jax.jit(
+        shard_map_compat(
+            local, mesh, in_specs=P(SEED_AXIS), out_specs=P(SEED_AXIS)
+        )
+    )
+
+
 def screen_history(rec, t, n, spec) -> bool:
     """Screen ONE seed's raw history rows (tests and replay tooling)."""
     fn = screen_for(spec)
@@ -272,14 +305,19 @@ def screen_history(rec, t, n, spec) -> bool:
     )
 
 
-def screen_sweep(final, spec, block: int = 1024) -> jnp.ndarray:
+def screen_sweep(final, spec, block: int = 1024, mesh=None) -> jnp.ndarray:
     """Suspect mask (bool[S], device array) for a finished batched sweep.
 
     ``block`` bounds the [block, H, H] pairwise-mask working set per
     launched program (H = hist_slots; 1024 lanes x 256 rows is ~67 MB of
     bool mask per term). The mask is NOT materialized to host — callers
     enqueue this right after the chunk's sweep and ``np.asarray`` it
-    later, from the overlapped host phase."""
+    later, from the overlapped host phase.
+
+    ``mesh`` runs the screen shard_map'd over the mesh's seed axis
+    (``final`` sharded by ``parallel.run_sweep_sharded``; the batch must
+    divide the mesh) — same bits per seed, distributed like the sweep
+    that produced the planes."""
     fn = screen_for(spec)
     if fn is None:
         raise ValueError(
@@ -291,6 +329,10 @@ def screen_sweep(final, spec, block: int = 1024) -> jnp.ndarray:
         # no recording plane: nothing to screen, nothing to check —
         # consistent with the checker accepting every empty history
         return jnp.zeros((S,), bool)
+    if mesh is not None:
+        return _batched_sharded(spec.name, mesh, block)(
+            final.hist_rec, final.hist_t, final.hist_len
+        )
     f = _batched(spec.name)
     if S <= block:
         return f(final.hist_rec, final.hist_t, final.hist_len)
@@ -352,13 +394,17 @@ def checked_sweep(
     seeds,
     spec,
     summarize,
-    chunk_size: int = 16384,
+    chunk_size: Optional[int] = None,
     workers: int = 0,
     max_states: int = 200_000,
     screen: bool = True,
     ckpt_dir: Optional[str] = None,
     stop_after: Optional[int] = None,
     resume_from=None,
+    mesh=None,
+    chunk_per_device: Optional[int] = None,
+    max_recorded: int = 32,
+    on_chunk=None,
 ) -> dict:
     """End-to-end checked sweep: pipelined chunked sweep + on-device
     screening + process-pool WGL checking, merged into one summary dict.
@@ -367,7 +413,22 @@ def checked_sweep(
     seeds/s through simulation AND history validation. ``screen=False``
     degrades to decode-and-check-every-seed (the naive baseline).
     Results are bit-identical across ``screen`` settings whenever the
-    screen is conservative, and across ``workers`` always."""
+    screen is conservative, and across ``workers`` always.
+    ``chunk_size=None`` (the default) auto-picks the occupancy knee
+    from the workload's measured loop-carry footprint, matching
+    ``engine.core.run_sweep_chunked``.
+
+    ``mesh`` routes the whole pipeline through the sharded driver
+    (``parallel.run_sweep_sharded_pipelined``): sweep, screen and
+    summary run sharded over the mesh, per-device chunks sized
+    ``chunk_per_device`` (``core.pick_chunk_size`` when omitted; an
+    explicit ``chunk_size`` stays GLOBAL and overrides). The summary
+    dict is byte-identical across mesh sizes: every count is an exact
+    integer reduction merged in seed order, and the
+    ``hist_violating_seeds`` sample composes chunking-invariantly —
+    each chunk records at most ``max_recorded`` violators (lane order)
+    and the merged list is capped to the same bound, so a prefix kept
+    per chunk can never change the global first-``max_recorded`` set."""
     from ..engine.checkpoint import run_sweep_pipelined
 
     screen_fn = None
@@ -377,18 +438,41 @@ def checked_sweep(
                 f"spec {spec.name!r} has no device screen; pass "
                 "screen=False to check every lane"
             )
-        screen_fn = lambda final: screen_sweep(final, spec)  # noqa: E731
-    return run_sweep_pipelined(
-        workload,
-        cfg,
-        seeds,
-        summarize,
-        host_work=history_host_work(
-            spec, max_states=max_states, workers=workers
-        ),
-        screen=screen_fn,
-        chunk_size=chunk_size,
-        ckpt_dir=ckpt_dir,
-        stop_after=stop_after,
-        resume_from=resume_from,
+        screen_fn = lambda final: screen_sweep(final, spec, mesh=mesh)  # noqa: E731
+    host_work = history_host_work(
+        spec, max_states=max_states, workers=workers,
+        max_recorded=max_recorded,
     )
+    if mesh is not None:
+        from ..parallel.mesh import run_sweep_sharded_pipelined
+
+        totals = run_sweep_sharded_pipelined(
+            workload, cfg, seeds, summarize,
+            mesh=mesh, host_work=host_work, screen=screen_fn,
+            chunk_per_device=chunk_per_device, chunk_size=chunk_size,
+            ckpt_dir=ckpt_dir, stop_after=stop_after,
+            resume_from=resume_from, on_chunk=on_chunk,
+        )
+    else:
+        if chunk_size is None:
+            from ..engine.core import pick_chunk_size
+
+            chunk_size = pick_chunk_size(workload, cfg)
+        totals = run_sweep_pipelined(
+            workload,
+            cfg,
+            seeds,
+            summarize,
+            host_work=host_work,
+            screen=screen_fn,
+            chunk_size=chunk_size,
+            ckpt_dir=ckpt_dir,
+            stop_after=stop_after,
+            resume_from=resume_from,
+            on_chunk=on_chunk,
+        )
+    if "hist_violating_seeds" in totals:
+        totals["hist_violating_seeds"] = totals["hist_violating_seeds"][
+            :max_recorded
+        ]
+    return totals

@@ -13,15 +13,25 @@ scaling rides ICI bandwidth-free.
 from .mesh import (
     seed_mesh,
     shard_seeds,
+    shard_state,
+    shard_map_compat,
+    mesh_layout,
     run_sweep_sharded,
     run_sweep_sharded_chunked,
+    run_sweep_sharded_pipelined,
+    resume_sweep_sharded,
     sharded_step,
 )
 
 __all__ = [
     "seed_mesh",
     "shard_seeds",
+    "shard_state",
+    "shard_map_compat",
+    "mesh_layout",
     "run_sweep_sharded",
     "run_sweep_sharded_chunked",
+    "run_sweep_sharded_pipelined",
+    "resume_sweep_sharded",
     "sharded_step",
 ]

@@ -16,7 +16,7 @@ resharding traffic to place.
 from __future__ import annotations
 
 from functools import lru_cache, partial
-from typing import Optional, Sequence
+from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -25,6 +25,27 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..engine.core import EngineConfig, EngineState, Workload, init_sweep, step_one
 
 SEED_AXIS = "seeds"
+
+
+def shard_map_compat(f, mesh: Mesh, in_specs, out_specs):
+    """``jax.shard_map`` across the jax versions this repo meets: newer
+    releases export it top-level with a ``check_vma`` knob, while 0.4.x
+    only has ``jax.experimental.shard_map.shard_map`` with the older
+    ``check_rep`` spelling. Both checkers are disabled for the same
+    reason (see ``sharded_step``): lax.switch branches mix mesh-constant
+    and mesh-varying outputs, which the replication checker rejects even
+    though the program is replication-safe."""
+    if hasattr(jax, "shard_map"):
+        return jax.shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_vma=False,
+        )
+    from jax.experimental.shard_map import shard_map as _sm
+
+    return _sm(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+        check_rep=False,
+    )
 
 
 def seed_mesh(devices: Optional[Sequence] = None) -> Mesh:
@@ -64,16 +85,16 @@ def sharded_step(workload: Workload, cfg: EngineConfig, mesh: Mesh):
         live = jnp.sum(~state.done, dtype=jnp.int32)
         return state, jax.lax.psum(live, SEED_AXIS)
 
-    # check_vma off: lax.switch branches mix mesh-constant and mesh-varying
-    # outputs (e.g. a constant event-kind vector vs a data-dependent one),
-    # which the varying-manual-axes checker rejects even though the program
-    # is replication-safe (communication happens only in the psum below).
-    return jax.shard_map(
+    # replication checking off: lax.switch branches mix mesh-constant and
+    # mesh-varying outputs (e.g. a constant event-kind vector vs a
+    # data-dependent one), which the varying-manual-axes checker rejects
+    # even though the program is replication-safe (communication happens
+    # only in the psum below).
+    return shard_map_compat(
         local_step,
-        mesh=mesh,
+        mesh,
         in_specs=(P(SEED_AXIS), P()),
         out_specs=(P(SEED_AXIS), P()),
-        check_vma=False,
     )
 
 
@@ -100,12 +121,11 @@ def _sharded_run(workload: Workload, cfg: EngineConfig, mesh: Mesh):
         return state
 
     return jax.jit(
-        jax.shard_map(
+        shard_map_compat(
             device_run,
-            mesh=mesh,
+            mesh,
             in_specs=P(SEED_AXIS),
             out_specs=P(SEED_AXIS),
-            check_vma=False,  # same rationale as sharded_step
         )
     )
 
@@ -164,4 +184,123 @@ def run_sweep_sharded_chunked(
         seeds,
         chunk_per_device * n_dev,
         multiple=n_dev,
+    )
+
+
+def shard_state(mesh: Mesh, state: EngineState) -> EngineState:
+    """Place a batched EngineState sharded over the mesh's seed axis
+    (every leaf's leading axis is the seed batch, so one PartitionSpec
+    covers the whole tree). Used to re-shard a checkpoint-restored state
+    onto whatever mesh the resuming process has — the snapshot itself is
+    host arrays with no layout, which is what makes a sweep interrupted
+    on 8 devices resumable on 1 (checkpoint format v8 carries the
+    original layout for chunk-boundary bookkeeping, not for data)."""
+    sharding = NamedSharding(mesh, P(SEED_AXIS))
+    return jax.device_put(state, sharding)
+
+
+def resume_sweep_sharded(
+    workload: Workload, cfg: EngineConfig, state: EngineState,
+    mesh: Optional[Mesh] = None,
+) -> EngineState:
+    """Continue a (possibly restored) sweep sharded over a mesh until
+    every seed finishes — the sharded analogue of
+    ``engine.checkpoint.resume_sweep``, bit-identical to it per seed.
+    The batch must divide the mesh size."""
+    if mesh is None:
+        mesh = seed_mesh()
+    if int(state.seed.shape[0]) % mesh.devices.size:
+        raise ValueError(
+            f"cannot resume a {int(state.seed.shape[0])}-lane snapshot on "
+            f"a {mesh.devices.size}-device mesh (batch must divide the "
+            "mesh; resume on a divisor mesh or unsharded)"
+        )
+    return _sharded_run(workload, cfg, mesh)(shard_state(mesh, state))
+
+
+def mesh_layout(mesh: Mesh, chunk_per_device: int) -> dict:
+    """The mesh-layout metadata a sharded sweep records in its v8
+    checkpoints (``engine.checkpoint.save_sweep(mesh_layout=)``): enough
+    to rebuild the GLOBAL chunk boundaries (``chunk_size =
+    chunk_per_device × n_dev``) on a resuming process with a different
+    device count, so per-chunk checkpoint files keep lining up."""
+    return {
+        "n_dev": int(mesh.devices.size),
+        "chunk_per_device": int(chunk_per_device),
+        "chunk_size": int(chunk_per_device) * int(mesh.devices.size),
+        "axis": SEED_AXIS,
+    }
+
+
+def run_sweep_sharded_pipelined(
+    workload: Workload,
+    cfg: EngineConfig,
+    seeds,
+    summarize,
+    *,
+    mesh: Optional[Mesh] = None,
+    host_work: Optional[Callable] = None,
+    screen: Optional[Callable] = None,
+    chunk_per_device: Optional[int] = None,
+    chunk_size: Optional[int] = None,
+    ckpt_dir: Optional[str] = None,
+    stop_after: Optional[int] = None,
+    resume_from: Optional[Tuple[EngineState, dict]] = None,
+    on_chunk: Optional[Callable] = None,
+) -> dict:
+    """The pipelined checked-sweep driver lifted onto the mesh: chunked
+    device sweeps run sharded over all devices (``run_sweep_sharded``),
+    the screen/summary programs are enqueued behind each chunk sharded
+    the same way, and the host phase (decode, WGL checking, triage) of
+    chunk N overlaps the sharded sweep of chunk N+1 exactly as in
+    ``engine.checkpoint.run_sweep_pipelined`` — a million-seed checked
+    campaign becomes ONE unit of work spanning every chip.
+
+    Chunk sizing: the device-memory knee is PER CHIP, so the global
+    chunk is ``chunk_per_device × n_dev`` lanes, with ``chunk_per_device``
+    auto-picked from the workload's measured loop-carry footprint
+    (``engine.core.pick_chunk_size``) when not given. An explicit
+    ``chunk_size`` (global) overrides both; either way the granule is
+    rounded up to mesh divisibility.
+
+    Report invariance contract: the merged summary dict is BYTE-IDENTICAL
+    across mesh sizes — on 1, 2, 4 and 8 devices — even though the chunk
+    boundaries differ (per-chunk summaries are exact integer reductions,
+    list fields merge in seed order, and caps compose chunking-invariantly;
+    tests/test_parallel.py pins the bytes). Checkpointing composes too:
+    per-chunk files carry no mesh identity, and a mid-chunk v8 snapshot
+    (``save_sweep(..., inflight=, mesh_layout=mesh_layout(mesh, cpd))``)
+    resumes bit-identical on ANY mesh whose size divides the chunk —
+    interrupt on 8 devices, resume on 1 (``resume_from=(state, inflight)``,
+    with ``chunk_size`` taken from the snapshot's mesh layout).
+    """
+    from ..engine.checkpoint import run_sweep_pipelined
+    from ..engine.core import pick_chunk_size
+
+    if mesh is None:
+        mesh = seed_mesh()
+    n_dev = int(mesh.devices.size)
+    if chunk_size is None:
+        if chunk_per_device is None:
+            chunk_per_device = pick_chunk_size(workload, cfg)
+        chunk_size = chunk_per_device * n_dev
+    chunk_size = -(-chunk_size // n_dev) * n_dev  # mesh divisibility
+
+    return run_sweep_pipelined(
+        workload,
+        cfg,
+        seeds,
+        summarize,
+        host_work=host_work,
+        screen=screen,
+        chunk_size=chunk_size,
+        ckpt_dir=ckpt_dir,
+        stop_after=stop_after,
+        resume_from=resume_from,
+        run_chunk=lambda chunk: run_sweep_sharded(workload, cfg, chunk, mesh),
+        resume_chunk=lambda state: resume_sweep_sharded(
+            workload, cfg, state, mesh
+        ),
+        pad_multiple=n_dev,
+        on_chunk=on_chunk,
     )

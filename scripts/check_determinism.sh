@@ -126,6 +126,36 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     exit 1
   fi
 
+  # sharded leg (docs/multichip.md): the SAME checked-sweep report must
+  # be byte-identical across two processes x two MESH sizes — sharding
+  # the sweep/screen/summary over a device mesh may change wall-clock
+  # and chunk boundaries, never a report byte. Compared against the
+  # unsharded w0 report above, so all three drivers (plain, pooled,
+  # sharded) are pinned to one byte string.
+  # JAX_PLATFORMS=cpu like every other leg: the m1 run sees >=1 device
+  # on any backend so the CPU-mesh re-exec is a no-op, and an
+  # accelerator-backend report here would turn the diff against the
+  # CPU-pinned w0 reference into a cross-backend assertion
+  for m in 1 2; do
+    for r in a b; do
+      JAX_PLATFORMS=cpu "${PY:-python}" scripts/checked_sweep_demo.py \
+        --seeds 96 --chunk-size 32 --workers 0 --mesh "$m" \
+        --report "$out/cs_${r}_m${m}.json" >"$out/cs_${r}_m${m}.log" 2>&1
+    done
+  done
+  if [ -s "$out/cs_a_m1.json" ] \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_m1.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_m1.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_m2.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_m2.json"; then
+    echo "determinism gate: OK (sharded checked sweep, 2 processes x 2 mesh sizes == unsharded, byte-identical)"
+  else
+    echo "determinism gate: FAILED — sharded checked-sweep reports differ from unsharded or are empty" >&2
+    for f in "$out"/cs_*_m*.json; do echo "--- $f"; cat "$f"; done >&2 || true
+    cat "$out"/cs_*_m*.log >&2 || true
+    exit 1
+  fi
+
   # wire leg (docs/wire.md): the Kafka-binary-wire load report and the
   # wire differential-fuzz report must each be byte-identical across two
   # processes; each load run ALSO asserts the second path in-process —

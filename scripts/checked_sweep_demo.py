@@ -15,7 +15,12 @@ twice x two pool sizes and byte-diffs the four reports. Timing goes to
 stderr, where the gate ignores it.
 
 Usage: python scripts/checked_sweep_demo.py [--seeds N] [--chunk-size C]
-           [--workers W] [--clean] [--report PATH]
+           [--workers W] [--clean] [--report PATH] [--mesh N]
+
+``--mesh N`` runs the identical pipeline sharded over an N-device mesh
+(re-execing under the forced CPU host mesh when needed) — the report
+must be byte-identical to the unsharded one; the determinism gate runs
+this across 2 processes x 2 mesh sizes and diffs all four.
 """
 
 from __future__ import annotations
@@ -43,7 +48,18 @@ def main() -> int:
         help="default config (no seeded bug): the checker must stay quiet",
     )
     ap.add_argument("--report", default=None)
+    ap.add_argument("--mesh", type=int, default=0,
+                    help="shard the pipeline over an N-device mesh")
     args = ap.parse_args()
+
+    mesh = None
+    if args.mesh:
+        from madsim_tpu._cpu_mesh_env import reexec_with_cpu_mesh
+
+        reexec_with_cpu_mesh(args.mesh)
+        from madsim_tpu import parallel
+
+        mesh = parallel.seed_mesh(jax.devices()[: args.mesh])
 
     from madsim_tpu.models import etcd
     from madsim_tpu.oracle.screen import checked_sweep
@@ -62,7 +78,7 @@ def main() -> int:
     t0 = time.perf_counter()
     totals = checked_sweep(
         wl, ecfg, seeds, etcd.history_spec(), etcd.sweep_summary,
-        chunk_size=args.chunk_size, workers=args.workers,
+        chunk_size=args.chunk_size, workers=args.workers, mesh=mesh,
     )
     wall = time.perf_counter() - t0
 

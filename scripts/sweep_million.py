@@ -14,11 +14,19 @@ reuses both the compiled sweep program AND the compiled summary program
 counting ``Finished XLA compilation`` events (``jax.log_compiles``)
 while the timed loop runs.
 
-Usage: python scripts/sweep_million.py [total_seeds] [ckpt_dir]
+Usage: python scripts/sweep_million.py [total_seeds] [ckpt_dir] [--mesh [N]]
 
 With ``ckpt_dir`` the sweep is preemption-safe: per-chunk summaries are
 checkpointed (engine.checkpoint.run_sweep_chunked_resumable) and a
 restarted run skips completed chunks.
+
+``--mesh`` (optionally ``--mesh N`` for an N-device mesh) runs every
+chunk sharded over the device mesh (``parallel.run_sweep_sharded``) —
+the same chunk granule spans all devices, summaries merge identically,
+and the per-chunk checkpoint files are mesh-free, so a sweep can be
+interrupted under one device count and finished under another. When the
+process sees fewer devices than requested it re-execs itself under the
+forced CPU host mesh (madsim_tpu._cpu_mesh_env).
 """
 
 from __future__ import annotations
@@ -72,10 +80,41 @@ def count_compiles():
 
 
 def main() -> None:
-    total = int(sys.argv[1]) if len(sys.argv) > 1 else 1 << 20
+    import argparse
+
+    ap = argparse.ArgumentParser()
+    ap.add_argument("total", type=int, nargs="?", default=1 << 20)
+    ap.add_argument("ckpt_dir", nargs="?", default=None)
+    ap.add_argument("--mesh", type=int, nargs="?", const=8, default=None,
+                    help="shard each chunk over an N-device mesh "
+                         "(bare --mesh picks 8)")
+    ns = ap.parse_args()
+    total = ns.total
+    mesh = None
+    n_dev = 0
+    if ns.mesh is not None:
+        n_dev = ns.mesh
+        from madsim_tpu._cpu_mesh_env import reexec_with_cpu_mesh
+
+        reexec_with_cpu_mesh(n_dev)
+        from madsim_tpu import parallel
+
+        mesh = parallel.seed_mesh(jax.devices()[:n_dev])
+        if CHUNK % n_dev or total % n_dev:
+            raise SystemExit(
+                f"chunk {CHUNK} and total {total} must divide the "
+                f"{n_dev}-device mesh"
+            )
     cfg = raft.RaftConfig(num_nodes=5, crashes=1)
     ecfg = raft.engine_config(cfg, time_limit_ns=3_000_000_000)
     wl = raft.workload(cfg)
+
+    def run_chunk(seed_chunk):
+        if mesh is None:
+            return core.run_sweep(wl, ecfg, seed_chunk)
+        from madsim_tpu import parallel
+
+        return parallel.run_sweep_sharded(wl, ecfg, seed_chunk, mesh)
 
     base = 1 << 30
     tail = total % CHUNK if total > CHUNK else 0
@@ -87,14 +126,12 @@ def main() -> None:
     # ... the warm seed range sits just below ``base`` so the offset
     # arange (an eager iota+add) is compiled here too, not in the loop
     warm_n = CHUNK if total > CHUNK else total
-    warm = core.run_sweep(
-        wl, ecfg, jnp.arange(base - warm_n, base, dtype=jnp.int64)
-    )
+    warm = run_chunk(jnp.arange(base - warm_n, base, dtype=jnp.int64))
     raft.sweep_summary(warm)
     if tail:
         raft.sweep_summary(warm, limit=tail)
 
-    ckpt_dir = sys.argv[2] if len(sys.argv) > 2 else None
+    ckpt_dir = ns.ckpt_dir
     chunks_preloaded = 0
     with count_compiles() as compiles:
         t0 = time.perf_counter()
@@ -113,7 +150,7 @@ def main() -> None:
             # not padded up to a full 16k-lane sweep
             totals = run_sweep_chunked_resumable(
                 wl, ecfg, seeds, raft.sweep_summary, ckpt_dir,
-                chunk_size=min(CHUNK, total),
+                chunk_size=min(CHUNK, total), run_chunk=run_chunk,
             )
         else:
             totals = {}
@@ -126,14 +163,12 @@ def main() -> None:
                     # padded lanes inside the one compiled summary
                     # program — no trim program, no recompile, not even
                     # an eager pad op
-                    final = core.run_sweep(
-                        wl, ecfg, jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
+                    final = run_chunk(
+                        jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
                     )
                     merge_summaries(totals, raft.sweep_summary(final, limit=k))
                 else:
-                    final = core.run_sweep(
-                        wl, ecfg, jnp.arange(lo, lo + k, dtype=jnp.int64)
-                    )
+                    final = run_chunk(jnp.arange(lo, lo + k, dtype=jnp.int64))
                     merge_summaries(totals, raft.sweep_summary(final))
         wall = time.perf_counter() - t0
 
@@ -159,6 +194,7 @@ def main() -> None:
                 # timed loop (0 = the warm-up paid for everything,
                 # ragged tail included)
                 "compiles_in_timed_region": compiles.count,
+                "mesh_devices": n_dev,
                 "backend": jax.default_backend(),
             }
         )

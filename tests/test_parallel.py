@@ -141,3 +141,180 @@ def test_mesh_size_must_divide_batch():
     mesh = parallel.seed_mesh(_cpu_devices(8))
     with pytest.raises(Exception):
         parallel.run_sweep_sharded(wl, ECFG, jnp.arange(12, dtype=jnp.int64), mesh)
+
+
+# ---------------------------------------------------------------------------
+# The {1, 2, 4, 8}-device equality matrix (ROADMAP item 1): sharding the
+# checked-sweep pipeline over the mesh must change NOTHING — per-seed
+# state bit-equal to unsharded at thousands of seeds, and every report
+# (summary totals, campaign JSONL, screen verdicts) byte-identical
+# across mesh sizes even though the chunk boundaries differ.
+
+MATRIX = (1, 2, 4, 8)
+MATRIX_SEEDS = 4096
+
+
+def _etcd_hist():
+    """A cheap history-recording etcd workload for the matrix tests."""
+    from madsim_tpu.models import etcd
+
+    cfg = etcd.EtcdConfig(hist_slots=128)
+    ecfg = etcd.engine_config(
+        cfg, time_limit_ns=500_000_000, max_steps=6_000
+    )
+    return etcd, etcd.workload(cfg), ecfg, etcd.history_spec()
+
+
+def test_mesh_matrix_per_seed_state_equality():
+    """Every mesh size yields the bit-identical final state per seed at
+    >= 4096 seeds (chunked + ragged boundaries differ per mesh size)."""
+    devs = _cpu_devices(8)
+    wl = raft.workload(CFG)
+    seeds = jnp.arange(MATRIX_SEEDS, dtype=jnp.int64)
+    cpu = jax.devices("cpu")[0]
+    with jax.default_device(cpu):
+        plain = ecore.run_sweep(wl, ECFG, seeds)
+    for n_dev in MATRIX:
+        mesh = parallel.seed_mesh(devs[:n_dev])
+        sharded = parallel.run_sweep_sharded_chunked(
+            wl, ECFG, seeds, mesh, chunk_per_device=1024
+        )
+        for a, b in zip(jax.tree.leaves(sharded), jax.tree.leaves(plain)):
+            if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+                a, b = jax.random.key_data(a), jax.random.key_data(b)
+            assert jnp.array_equal(jax.device_get(a), jax.device_get(b)), (
+                f"leaf mismatch at {n_dev} devices"
+            )
+
+
+def test_mesh_matrix_checked_sweep_report_bytes():
+    """The merged checked-sweep summary (sweep + device screen + WGL
+    checking) is byte-identical on 1, 2, 4 and 8 devices AND equal to
+    the unsharded pipelined driver — with per-device chunking, so the
+    chunk boundaries differ at every mesh size."""
+    import json
+
+    from madsim_tpu.oracle.screen import checked_sweep
+
+    devs = _cpu_devices(8)
+    _etcd, wl, ecfg, spec = _etcd_hist()
+    seeds = jnp.arange(MATRIX_SEEDS, dtype=jnp.int64)
+    ref = json.dumps(
+        checked_sweep(
+            wl, ecfg, seeds, spec, _etcd.sweep_summary, chunk_size=1024
+        ),
+        sort_keys=True,
+    )
+    for n_dev in MATRIX:
+        mesh = parallel.seed_mesh(devs[:n_dev])
+        blob = json.dumps(
+            checked_sweep(
+                wl, ecfg, seeds, spec, _etcd.sweep_summary,
+                mesh=mesh, chunk_per_device=512,
+            ),
+            sort_keys=True,
+        )
+        assert blob == ref, f"report bytes differ at {n_dev} devices"
+
+
+def test_mesh_screen_matches_unsharded():
+    """The shard_map'd device screen produces the identical suspect mask
+    as the single-device screen, per mesh size."""
+    from madsim_tpu.oracle.screen import screen_sweep
+
+    devs = _cpu_devices(8)
+    _etcd, wl, ecfg, spec = _etcd_hist()
+    seeds = jnp.arange(512, dtype=jnp.int64)
+    plain = ecore.run_sweep(wl, ecfg, seeds)
+    want = jax.device_get(screen_sweep(plain, spec, block=128))
+    for n_dev in (2, 8):
+        mesh = parallel.seed_mesh(devs[:n_dev])
+        final = parallel.run_sweep_sharded(wl, ecfg, seeds, mesh)
+        got = jax.device_get(screen_sweep(final, spec, block=128, mesh=mesh))
+        assert jnp.array_equal(got, want), f"screen differs at {n_dev} devices"
+
+
+def test_mesh_matrix_campaign_report_bytes(tmp_path):
+    """One coverage-guided campaign (seeded mutations, history screening
+    + checking) emits byte-identical JSONL reports on every mesh size."""
+    from madsim_tpu.engine.faults import FaultSpec
+    from madsim_tpu.explore import CampaignConfig, run_campaign
+    from madsim_tpu.explore.targets import amnesia_raft_target
+
+    devs = _cpu_devices(8)
+    target = amnesia_raft_target(
+        time_limit_ns=1_000_000_000, max_steps=10_000, hist_slots=16
+    )
+    base = FaultSpec(
+        crashes=2, crash_window_ns=800_000_000,
+        restart_lo_ns=50_000_000, restart_hi_ns=300_000_000,
+    )
+    ccfg = CampaignConfig(rounds=2, seeds_per_round=256, chunk_size=128)
+    blobs = {}
+    for n_dev in MATRIX:
+        path = tmp_path / f"campaign_{n_dev}.jsonl"
+        run_campaign(
+            target, base, ccfg, report_path=str(path),
+            mesh=parallel.seed_mesh(devs[:n_dev]),
+        )
+        blobs[n_dev] = path.read_bytes()
+    assert len(set(blobs.values())) == 1, (
+        f"campaign report bytes differ across mesh sizes "
+        f"{[len(b) for b in blobs.values()]}"
+    )
+
+
+def test_interrupt_on_8_resume_on_1_checkpoint_portability(tmp_path):
+    """A checked sweep interrupted MID-CHUNK on an 8-device mesh resumes
+    bit-identical on a single device (and vice versa): the v8 snapshot
+    carries the mesh layout whose global chunk size the resuming mesh
+    honors, and the state arrays themselves are layout-free."""
+    import json
+
+    from madsim_tpu.engine import checkpoint
+    from madsim_tpu.models import etcd
+
+    devs = _cpu_devices(8)
+    _etcd, wl, ecfg, spec = _etcd_hist()
+    short = etcd.engine_config(
+        etcd.EtcdConfig(hist_slots=128),
+        time_limit_ns=500_000_000, max_steps=300,
+    )
+    seeds = jnp.arange(1024, dtype=jnp.int64)
+    mesh8 = parallel.seed_mesh(devs[:8])
+    mesh1 = parallel.seed_mesh(devs[:1])
+
+    straight = parallel.run_sweep_sharded_pipelined(
+        wl, ecfg, seeds, _etcd.sweep_summary, mesh=mesh1, chunk_size=512
+    )
+
+    # interrupt chunk 0 mid-flight on the 8-device mesh
+    partial = parallel.run_sweep_sharded(wl, short, seeds[:512], mesh8)
+    path = str(tmp_path / "mid.npz")
+    layout = parallel.mesh_layout(mesh8, 64)
+    checkpoint.save_sweep(
+        partial, path, inflight={"lo": 0, "k": 512}, mesh_layout=layout
+    )
+    got_layout = checkpoint.load_mesh_layout(path)
+    assert got_layout == layout and got_layout["chunk_size"] == 512
+    restored = checkpoint.load_sweep(path, like=partial)
+    inflight = checkpoint.load_inflight(path)
+
+    resumed = parallel.run_sweep_sharded_pipelined(
+        wl, ecfg, seeds, _etcd.sweep_summary, mesh=mesh1,
+        chunk_size=got_layout["chunk_size"],
+        resume_from=(restored, inflight),
+    )
+    assert json.dumps(resumed, sort_keys=True) == json.dumps(
+        straight, sort_keys=True
+    )
+
+    # and the mirror: interrupted unsharded, resumed on the full mesh
+    resumed8 = parallel.run_sweep_sharded_pipelined(
+        wl, ecfg, seeds, _etcd.sweep_summary, mesh=mesh8,
+        chunk_size=got_layout["chunk_size"],
+        resume_from=(restored, inflight),
+    )
+    assert json.dumps(resumed8, sort_keys=True) == json.dumps(
+        straight, sort_keys=True
+    )

@@ -378,6 +378,10 @@ def _serial_checked(wl, ecfg, seeds, spec, chunk_size):
             }
         )
         merge_summaries(totals, s)
+    # the driver caps the MERGED sample at the same per-chunk bound —
+    # the composition that makes the list chunking-invariant (and
+    # therefore mesh-size-invariant, docs/multichip.md)
+    totals["hist_violating_seeds"] = totals["hist_violating_seeds"][:32]
     return totals
 
 
