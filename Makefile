@@ -63,7 +63,8 @@ determinism:
 # of paying ~70 s to repeat the same deterministic computation
 explore-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/explore_demo.py \
-	  --rounds 6 --seeds-per-round 128 --campaign-seed 5
+	  --rounds 6 --seeds-per-round 128 --campaign-seed 5 \
+	  --assert-zero-recompile
 
 # the history-oracle pipeline end to end (docs/oracle.md): seeded etcd
 # stale-read bug -> WGL checker rejects -> history-flavor triage ->

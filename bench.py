@@ -80,6 +80,15 @@ CHECK_WORKERS = 8
 # pipelined-recovery leg: 2 chunks, interrupted mid-chunk-0
 PIPE_SEEDS = 2048
 PIPE_CHUNK = 1024
+# campaign leg (explore-candidate throughput): K mutated candidates per
+# measured batch, serial compile-per-candidate (the pre-refactor explore
+# path) vs ONE batched (candidate x seed) spec-as-data grid — the
+# compile-bound regime the spec-as-data refactor targets, so the figure
+# of merit is end-to-end candidates/s including compiles
+CAMPAIGN_K = 16
+CAMPAIGN_SEEDS = 256
+CAMPAIGN_REPS = 2
+CAMPAIGN_SIM_SECONDS = 1.5
 
 _seed_cursor = [1]
 
@@ -372,6 +381,102 @@ def bench_recovery_pipelined() -> dict:
     }
 
 
+def bench_campaign() -> dict:
+    """Explore-candidate throughput, serial vs batched grid.
+
+    Per rep (interleaved A/B, docs/pallas_finding.md §0): leg A sweeps
+    ``CAMPAIGN_K`` FRESH mutated candidates the pre-refactor way — every
+    candidate a new jit cache key, so every candidate pays the sweep
+    compile (the production regime a coverage-guided campaign used to
+    live in); leg B stacks the same-count fresh candidates into one
+    (candidate x seed) spec-as-data grid over the warmed envelope
+    program. Fresh candidates every rep keep leg A honestly
+    compile-bound and leg B honestly data-bound; compiles are COUNTED in
+    both timed regions (engine/compiles.py), so the speedup is
+    attributable, not asserted."""
+    import random
+
+    from madsim_tpu import explore
+    from madsim_tpu.engine.compiles import count_compiles
+    from madsim_tpu.engine.faults import FaultSpec
+
+    target = explore.amnesia_raft_target(
+        time_limit_ns=int(CAMPAIGN_SIM_SECONDS * 1e9), max_steps=15_000
+    )
+    base = FaultSpec(
+        crashes=3,
+        crash_window_ns=1_200_000_000,
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=300_000_000,
+    )
+    env = explore.target_envelope(target, base)
+    rng = random.Random(0xBE7C)
+    seen = set()
+
+    def fresh_candidates():
+        # distinct across the whole bench: a repeated spec would hit the
+        # serial leg's jit cache and understate its per-candidate compile
+        out = []
+        while len(out) < CAMPAIGN_K:
+            spec = explore.mutate_spec(base, rng, 2)
+            if spec not in seen:
+                seen.add(spec)
+                out.append(spec)
+        return out
+
+    def ccfg_at(seed0: int) -> explore.CampaignConfig:
+        return explore.CampaignConfig(
+            seeds_per_round=CAMPAIGN_SEEDS, seed0=seed0
+        )
+
+    # warm the grid's programs (envelope sweep, lane slice, summary)
+    # outside every timed region; the serial leg has nothing to warm —
+    # paying the compiler per candidate IS that leg
+    explore.sweep_candidate_grid(
+        target, fresh_candidates(), ccfg_at(int(_fresh(CAMPAIGN_SEEDS)[0])),
+        env,
+    )
+
+    serial_times, grid_times = [], []
+    serial_compiles = grid_compiles = 0
+    for _ in range(CAMPAIGN_REPS):
+        cand_a, cand_b = fresh_candidates(), fresh_candidates()
+        s0a = int(_fresh(CAMPAIGN_SEEDS)[0])
+        s0b = int(_fresh(CAMPAIGN_SEEDS)[0])
+        with count_compiles() as c:
+            t0 = walltime.perf_counter()
+            for spec in cand_a:
+                explore.campaign._sweep_candidate(
+                    target, spec, ccfg_at(s0a), None
+                )
+            serial_times.append(walltime.perf_counter() - t0)
+        serial_compiles += c.count
+        with count_compiles() as c:
+            t0 = walltime.perf_counter()
+            explore.sweep_candidate_grid(target, cand_b, ccfg_at(s0b), env)
+            grid_times.append(walltime.perf_counter() - t0)
+        grid_compiles += c.count
+
+    rate_serial = CAMPAIGN_K / min(serial_times)
+    rate_grid = CAMPAIGN_K / min(grid_times)
+    return {
+        "candidates": CAMPAIGN_K,
+        "seeds_per_candidate": CAMPAIGN_SEEDS,
+        "reps": CAMPAIGN_REPS,
+        "serial_per_candidate": {
+            "candidates_per_sec": round(rate_serial, 2),
+            "compiles_in_timed_region": serial_compiles,
+            "spread": _spread(serial_times),
+        },
+        "batched_grid": {
+            "candidates_per_sec": round(rate_grid, 2),
+            "compiles_in_timed_region": grid_compiles,
+            "spread": _spread(grid_times),
+        },
+        "speedup_vs_serial": round(rate_grid / rate_serial, 1),
+    }
+
+
 def _leaf_np(a):
     """Host array for comparison; typed PRNG keys via their raw words."""
     if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
@@ -527,6 +632,7 @@ def main() -> None:
     cross = bench_cross_backend(wl, ecfg)
     kafka_line, etcd_line = bench_secondary_models()
     checked = bench_checked_sweep()
+    campaign = bench_campaign()
 
     # HEADLINE = the chunked 131k sweep: the production pattern, and —
     # at ~3 s of device work per rep — the only number the tunneled
@@ -576,6 +682,7 @@ def main() -> None:
                 },
                 "sweep_100k": big,
                 "checked_sweep": checked,
+                "campaign": campaign,
                 "recovery_e2e": recovery,
                 "cross_backend": cross,
                 "kafka": kafka_line,
@@ -594,6 +701,7 @@ def _smoke() -> None:
     global CURVE, BIG_TOTAL, BIG_CHUNK, HOST_SEEDS, REPS, SIM_SECONDS
     global PARITY_SEEDS, CHECKED_TOTAL, CHECKED_CHUNK, CHECKED_SIM_SECONDS
     global NAIVE_SEEDS, CHECK_WORKERS, PIPE_SEEDS, PIPE_CHUNK
+    global CAMPAIGN_K, CAMPAIGN_SEEDS, CAMPAIGN_REPS, CAMPAIGN_SIM_SECONDS
     # shrink the auto-picked curve point too: the default 128 MiB budget
     # would land it at 16k lanes — ~45 s of CPU sweeps in a smoke run
     os.environ.setdefault("MADSIM_CHUNK_BUDGET_BYTES", str(8 << 20))
@@ -611,9 +719,18 @@ def _smoke() -> None:
     CHECK_WORKERS = 2
     PIPE_SEEDS = 128
     PIPE_CHUNK = 64
+    CAMPAIGN_K = 4
+    CAMPAIGN_SEEDS = 32
+    CAMPAIGN_REPS = 1
+    CAMPAIGN_SIM_SECONDS = 0.5
 
 
 if __name__ == "__main__":
     if "--smoke" in sys.argv:
         _smoke()
-    main()
+    if "--campaign" in sys.argv:
+        # the campaign leg standalone (CPU is the compile-dominated
+        # regime the ≥5x acceptance figure is measured in)
+        print(json.dumps({"metric": "campaign_leg", **bench_campaign()}))
+    else:
+        main()

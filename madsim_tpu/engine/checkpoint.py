@@ -205,6 +205,24 @@ def _write_chunk_summary(
     os.replace(tmp, path)
 
 
+def params_digest(params) -> str:
+    """Candidate identity of a per-lane spec-as-data pytree: a sha256
+    over every leaf's bytes. Appended to ``_sweep_fingerprint`` so chunk
+    checkpoints written for one candidate can never silently merge into
+    another candidate's sweep (the envelope alone is shared by ALL
+    candidates — that sharing is the point of the spec-as-data path, so
+    the data itself must join the identity)."""
+    import hashlib
+
+    h = hashlib.sha256()
+    for leaf in jax.tree.leaves(params):
+        a = np.ascontiguousarray(np.asarray(leaf))
+        h.update(str(a.dtype).encode())
+        h.update(str(a.shape).encode())
+        h.update(a.tobytes())
+    return h.hexdigest()[:16]
+
+
 def run_sweep_chunked_resumable(
     workload: Workload,
     cfg: EngineConfig,
@@ -213,6 +231,7 @@ def run_sweep_chunked_resumable(
     ckpt_dir: str,
     chunk_size: int = 16384,
     run_chunk: Optional[Callable] = None,
+    params=None,
 ) -> dict:
     """Pod-scale sweep that survives interruption at chunk granularity.
 
@@ -239,11 +258,18 @@ def run_sweep_chunked_resumable(
     """
     import os
 
-    from .core import _concat_finals, _pad_seeds, run_sweep
+    from .core import (
+        _concat_finals, _pad_params, _pad_seeds, _slice_params, run_sweep,
+    )
     from ..models._common import merge_summaries  # lazy: models import us
 
     if run_chunk is None:
-        run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
+        if params is None:
+            run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
+        else:
+            run_chunk = lambda chunk, pchunk: run_sweep(  # noqa: E731
+                workload, cfg, chunk, params=pchunk
+            )
     seeds = jnp.asarray(seeds, jnp.int64)
     seeds_host = np.asarray(seeds)  # bookkeeping reads skip the device
     n = int(seeds.shape[0])
@@ -252,6 +278,8 @@ def run_sweep_chunked_resumable(
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
     fp = _sweep_fingerprint(workload, cfg)
+    if params is not None:
+        fp += "|params" + params_digest(params)
     os.makedirs(ckpt_dir, exist_ok=True)
     totals: dict = {}
     for lo in range(0, n, chunk_size):
@@ -271,7 +299,15 @@ def run_sweep_chunked_resumable(
             # k-shaped trim program
             chunk = seeds[lo : lo + chunk_size]
             pad = chunk_size - k
-            final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
+            if params is None:
+                final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
+            else:
+                pchunk = _slice_params(params, lo, lo + chunk_size)
+                if pad:
+                    pchunk = _pad_params(pchunk, pad)
+                final = run_chunk(
+                    _pad_seeds(chunk, pad) if pad else chunk, pchunk
+                )
             if pad and getattr(summarize, "supports_limit", False):
                 summary = summarize(final, limit=k)
             else:
@@ -299,6 +335,7 @@ def run_sweep_pipelined(
     resume_chunk: Optional[Callable] = None,
     pad_multiple: int = 1,
     on_chunk: Optional[Callable] = None,
+    params=None,
 ) -> dict:
     """Chunked sweep with the host phase of chunk N overlapped against
     the device sweep of chunk N+1 — the driver that makes END-TO-END
@@ -356,14 +393,28 @@ def run_sweep_pipelined(
     final chunk's. ``on_chunk(lo=, k=, summary=)`` fires as each chunk's
     summary is merged (in seed order) — progress reporting and
     time-to-first-violation measurement at the million-seed scale.
+
+    ``params`` carries per-lane spec-as-data (engine/faults.py): each
+    chunk's ``run_chunk(seed_chunk, param_chunk)`` receives the matching
+    lane slice, edge-padded like the seeds; the checkpoint fingerprint
+    gains the params digest so one candidate's chunk files can never
+    merge into another candidate's sweep.
     """
     import os
 
-    from .core import _concat_finals, _pad_seeds, run_sweep, _drive
+    from .core import (
+        _concat_finals, _pad_params, _pad_seeds, _slice_params, run_sweep,
+        _drive,
+    )
     from ..models._common import merge_summaries  # lazy: models import us
 
     if run_chunk is None:
-        run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
+        if params is None:
+            run_chunk = lambda chunk: run_sweep(workload, cfg, chunk)  # noqa: E731
+        else:
+            run_chunk = lambda chunk, pchunk: run_sweep(  # noqa: E731
+                workload, cfg, chunk, params=pchunk
+            )
     if resume_chunk is None:
         resume_chunk = lambda state: _drive(workload, cfg, state)  # noqa: E731
     seeds = jnp.asarray(seeds, jnp.int64)
@@ -374,6 +425,8 @@ def run_sweep_pipelined(
     if chunk_size < 1:
         raise ValueError(f"chunk_size must be >= 1, got {chunk_size}")
     fp = _sweep_fingerprint(workload, cfg)
+    if params is not None:
+        fp += "|params" + params_digest(params)
     if ckpt_dir is not None:
         os.makedirs(ckpt_dir, exist_ok=True)
     supports_limit = bool(getattr(summarize, "supports_limit", False))
@@ -445,7 +498,15 @@ def run_sweep_pipelined(
             final = resume_chunk(state)
         else:
             chunk = seeds[lo : lo + chunk_size]
-            final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
+            if params is None:
+                final = run_chunk(_pad_seeds(chunk, pad) if pad else chunk)
+            else:
+                pchunk = _slice_params(params, lo, lo + chunk_size)
+                if pad:
+                    pchunk = _pad_params(pchunk, pad)
+                final = run_chunk(
+                    _pad_seeds(chunk, pad) if pad else chunk, pchunk
+                )
         susp = screen(final) if screen is not None else None
 
         # -- previous chunk's host phase overlaps this chunk's sweep ----

@@ -1,0 +1,47 @@
+"""XLA compile counting: the honest program-reuse measurement.
+
+``count_compiles()`` wraps a code region in ``jax.log_compiles`` and
+counts "Finished XLA compilation" log records — the ground truth for
+every zero-recompile claim in this repo (a ragged tail, a mutated
+campaign candidate, or a differential-grid spec that recompiles anything
+shows up here; self-reported shape bookkeeping does not count).
+
+Grew out of scripts/sweep_million.py's one-script hack; now a first-class
+metric shared by the explore demo, the campaign bench leg, and the
+spec-as-data tests (tests/test_fault_params.py), so "compiles in the
+timed region" is reported the same way everywhere.
+"""
+
+from __future__ import annotations
+
+import logging
+from contextlib import contextmanager
+
+import jax
+
+
+class CompileCounter(logging.Handler):
+    """Counts finished XLA compilations surfaced by ``jax.log_compiles``."""
+
+    def __init__(self):
+        super().__init__(level=logging.WARNING)
+        self.count = 0
+
+    def emit(self, record):
+        if "Finished XLA compilation" in record.getMessage():
+            self.count += 1
+
+
+@contextmanager
+def count_compiles():
+    """``with count_compiles() as c:`` ... ``c.count`` is the number of
+    XLA compilations the region performed (0 after a proper warm-up is
+    the spec-as-data contract — docs/faults.md)."""
+    handler = CompileCounter()
+    logger = logging.getLogger("jax")
+    logger.addHandler(handler)
+    try:
+        with jax.log_compiles(True):
+            yield handler
+    finally:
+        logger.removeHandler(handler)

@@ -162,7 +162,9 @@ class EngineState(NamedTuple):
     wstate: Any  # workload pytree
 
 
-def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> EngineState:
+def _init_one(
+    workload: Workload, cfg: EngineConfig, seed: jnp.ndarray, params=None
+) -> EngineState:
     if workload.max_emits > cfg.queue_capacity:
         raise ValueError(
             f"workload.max_emits ({workload.max_emits}) exceeds "
@@ -177,7 +179,12 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
             "chunked driver would have rejected is still a config bug)"
         )
     key = seed_key(seed)
-    wstate, emits = workload.init(key)
+    # spec-as-data (engine/faults.py): a params-carrying workload builds
+    # its fault schedule from this lane's traced FaultParams instead of a
+    # static spec — the jit key stays the envelope shape
+    wstate, emits = (
+        workload.init(key) if params is None else workload.init(key, params)
+    )
     q = equeue.make(
         cfg.queue_capacity, workload.payload_slots,
         legacy=bool(cfg.legacy_queue),
@@ -201,10 +208,18 @@ def _init_one(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray) -> Engin
     )
 
 
-def init_sweep(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
-    """Build the batched state for a seed vector (int64[S])."""
+def init_sweep(
+    workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray, params=None
+) -> EngineState:
+    """Build the batched state for a seed vector (int64[S]). ``params``
+    (optional) is a PER-LANE pytree — leading axis S on every leaf, e.g.
+    ``faults.tile_params`` of one candidate or a stacked candidate×seed
+    grid — vmapped alongside the seed axis."""
     _procs_child_guard()
-    return jax.vmap(partial(_init_one, workload, cfg))(jnp.asarray(seeds, jnp.int64))
+    seeds = jnp.asarray(seeds, jnp.int64)
+    if params is None:
+        return jax.vmap(partial(_init_one, workload, cfg))(seeds)
+    return jax.vmap(partial(_init_one, workload, cfg))(seeds, params)
 
 
 def _procs_child_guard() -> None:
@@ -344,8 +359,10 @@ def drive(workload: Workload, cfg: EngineConfig, state: EngineState) -> EngineSt
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _init(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
-    return init_sweep(workload, cfg, seeds)
+def _init(
+    workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray, params=None
+) -> EngineState:
+    return init_sweep(workload, cfg, seeds, params)
 
 
 @partial(jax.jit, static_argnums=(0, 1))
@@ -353,20 +370,25 @@ def _drive(workload: Workload, cfg: EngineConfig, state: EngineState) -> EngineS
     return drive(workload, cfg, state)
 
 
-def _run(workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray) -> EngineState:
+def _run(
+    workload: Workload, cfg: EngineConfig, seeds: jnp.ndarray, params=None
+) -> EngineState:
     # init and the sweep loop are SEPARATE XLA programs on purpose: fusing
     # the unrolled per-seed init writes into the loop program pessimizes
     # the loop carry (measured 4.4 ms/step fused vs 0.43 ms/step split at
     # a 16k batch on v5e — layouts chosen for the init scatter leak into
     # every loop iteration). One extra dispatch per sweep is noise.
-    return _drive(workload, cfg, _init(workload, cfg, seeds))
+    return _drive(workload, cfg, _init(workload, cfg, seeds, params))
 
 
-def run_sweep(workload: Workload, cfg: EngineConfig, seeds) -> EngineState:
+def run_sweep(workload: Workload, cfg: EngineConfig, seeds, params=None) -> EngineState:
     """Run a whole seed batch to completion; returns the final batched
-    state (workload stats live in ``.wstate``)."""
+    state (workload stats live in ``.wstate``). ``params`` carries
+    per-lane spec-as-data (see ``init_sweep``); its leaves are traced jit
+    arguments, so sweeping a new candidate costs NO recompile as long as
+    the envelope (and thus every shape) is unchanged."""
     _procs_child_guard()
-    return _run(workload, cfg, jnp.asarray(seeds, jnp.int64))
+    return _run(workload, cfg, jnp.asarray(seeds, jnp.int64), params)
 
 
 @partial(jax.jit, static_argnums=(0,))
@@ -380,6 +402,19 @@ def _concat_finals(total: int, *finals):
     )
 
 
+@partial(jax.jit, static_argnums=(1,))
+def lane_slice(state, n: int, lo):
+    """Lanes ``[lo, lo + n)`` of a batched state tree as ONE compiled
+    program for every offset: ``lo`` is a traced scalar (dynamic slice),
+    only the window size is static. The (candidate x seed) grid path
+    carves its per-candidate summaries out of one flat sweep with this —
+    K candidates cost K dispatches of one program, zero recompiles."""
+    lo = jnp.asarray(lo, jnp.int32)
+    return jax.tree.map(
+        lambda a: jax.lax.dynamic_slice_in_dim(a, lo, n, axis=0), state
+    )
+
+
 def _pad_seeds(seeds, pad: int):
     """Append ``pad`` synthetic continuation seeds (max real seed + i +
     1); the padded lanes are sliced off inside ``_concat_finals``."""
@@ -387,7 +422,24 @@ def _pad_seeds(seeds, pad: int):
     return jnp.concatenate([seeds, filler])
 
 
-def run_in_chunks(run_chunk, seeds, chunk_size: int, multiple: int = 1):
+def _pad_params(params, pad: int):
+    """Edge-replicate per-lane params for ``pad`` synthetic lanes (their
+    results are trimmed/masked like the padded seeds'; any valid params
+    do — the last lane's are simply already there)."""
+    return jax.tree.map(
+        lambda a: np.concatenate(
+            [np.asarray(a), np.broadcast_to(np.asarray(a)[-1:], (pad,) + np.shape(a)[1:])]
+        ),
+        params,
+    )
+
+
+def _slice_params(params, lo: int, hi: int):
+    """Per-lane params for one chunk's lane slice."""
+    return jax.tree.map(lambda a: np.asarray(a)[lo:hi], params)
+
+
+def run_in_chunks(run_chunk, seeds, chunk_size: int, multiple: int = 1, params=None):
     """Shared chunk/pad/concat driver for large sweeps: run
     ``run_chunk(seed_chunk)`` over sequential ``chunk_size`` slices and
     concatenate the final states (single trim+concat program).
@@ -395,33 +447,58 @@ def run_in_chunks(run_chunk, seeds, chunk_size: int, multiple: int = 1):
     A ragged final chunk is padded to the full ``chunk_size`` so every
     chunk reuses one compiled program; a batch smaller than one chunk is
     padded only to the next ``multiple`` (divisibility, e.g. a mesh
-    size) — there is no program reuse to justify full-chunk padding."""
+    size) — there is no program reuse to justify full-chunk padding.
+
+    With per-lane ``params`` (spec-as-data), ``run_chunk(seed_chunk,
+    param_chunk)`` receives the matching slice, edge-padded like the
+    seeds."""
     seeds = jnp.asarray(seeds, jnp.int64)
     n = int(seeds.shape[0])
     if n == 0:
         raise ValueError("seed batch is empty")
+
+    def _run(chunk, pchunk):
+        return run_chunk(chunk) if params is None else run_chunk(chunk, pchunk)
+
     if n <= chunk_size:
         pad = -n % multiple
         if pad == 0:
-            return run_chunk(seeds)
-        return _concat_finals(n, run_chunk(_pad_seeds(seeds, pad)))
+            return _run(seeds, params)
+        padded = None if params is None else _pad_params(params, pad)
+        return _concat_finals(n, _run(_pad_seeds(seeds, pad), padded))
     finals = []
     for lo in range(0, n, chunk_size):
         chunk = seeds[lo : lo + chunk_size]
+        pchunk = None if params is None else _slice_params(params, lo, lo + chunk_size)
         pad = chunk_size - chunk.shape[0]
         if pad:
             chunk = _pad_seeds(chunk, pad)
-        finals.append(run_chunk(chunk))
+            if pchunk is not None:
+                pchunk = _pad_params(pchunk, pad)
+        finals.append(_run(chunk, pchunk))
     return _concat_finals(n, *finals)
 
 
-def state_bytes_per_seed(workload: Workload, cfg: EngineConfig) -> int:
+def state_bytes_per_seed(workload: Workload, cfg: EngineConfig, params=None) -> int:
     """Loop-carry bytes ONE seed lane holds through the sweep loop —
     the quantity whose batch-sized total stops fitting fast memory at
     the occupancy cliff (docs/pallas_finding.md §5). Computed from the
-    abstract shapes of ``_init_one`` (no device work, no compile)."""
+    abstract shapes of ``_init_one`` (no device work, no compile).
+    ``params`` is one lane's spec-as-data pytree (unbatched) for
+    envelope-keyed workloads, whose carry includes the per-lane
+    ``FaultRt`` scalars."""
+    pstruct = (
+        None
+        if params is None
+        else jax.tree.map(
+            lambda a: jax.ShapeDtypeStruct(np.shape(a), np.asarray(a).dtype),
+            params,
+        )
+    )
     shapes = jax.eval_shape(
-        partial(_init_one, workload, cfg), jax.ShapeDtypeStruct((), jnp.int64)
+        partial(_init_one, workload, cfg),
+        jax.ShapeDtypeStruct((), jnp.int64),
+        pstruct,
     )
     total = 0
     for leaf in jax.tree.leaves(shapes):
@@ -452,6 +529,7 @@ def pick_chunk_size(
     budget_bytes: Optional[int] = None,
     lo: int = 1024,
     hi: int = 65536,
+    params=None,
 ) -> int:
     """Largest power-of-two batch in ``[lo, hi]`` whose loop carry fits
     the fast-memory budget — the measured knee of the batch curve, not a
@@ -468,7 +546,7 @@ def pick_chunk_size(
                 "MADSIM_CHUNK_BUDGET_BYTES", DEFAULT_CHUNK_BUDGET_BYTES
             )
         )
-    per_seed = max(1, state_bytes_per_seed(workload, cfg))
+    per_seed = max(1, state_bytes_per_seed(workload, cfg, params=params))
     size = lo
     while size * 2 <= hi and size * 2 * per_seed <= budget_bytes:
         size *= 2
@@ -476,7 +554,11 @@ def pick_chunk_size(
 
 
 def run_sweep_chunked(
-    workload: Workload, cfg: EngineConfig, seeds, chunk_size: Optional[int] = None
+    workload: Workload,
+    cfg: EngineConfig,
+    seeds,
+    chunk_size: Optional[int] = None,
+    params=None,
 ) -> EngineState:
     """Run a large seed sweep as sequential ``chunk_size`` batches of
     ONE compiled program, concatenating the final states.
@@ -496,15 +578,25 @@ def run_sweep_chunked(
     per-chunk ``sweep_summary`` dicts on host per chunk, as bench.py's
     bench_100k does."""
     if chunk_size is None:
-        chunk_size = pick_chunk_size(workload, cfg)
+        chunk_size = pick_chunk_size(
+            workload, cfg,
+            params=None
+            if params is None
+            else jax.tree.map(lambda a: np.asarray(a)[0], params),
+        )
+    if params is None:
+        return run_in_chunks(
+            lambda chunk: run_sweep(workload, cfg, chunk), seeds, chunk_size
+        )
     return run_in_chunks(
-        lambda chunk: run_sweep(workload, cfg, chunk), seeds, chunk_size
+        lambda chunk, pchunk: run_sweep(workload, cfg, chunk, params=pchunk),
+        seeds, chunk_size, params=params,
     )
 
 
 @partial(jax.jit, static_argnums=(0, 1))
-def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray):
-    state = _init_one(workload, cfg, seed)
+def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray, params=None):
+    state = _init_one(workload, cfg, seed, params)
 
     def scan_step(s, _):
         before_ctr = s.ctr
@@ -537,11 +629,14 @@ def _run_traced(workload: Workload, cfg: EngineConfig, seed: jnp.ndarray):
     return final, trace
 
 
-def run_traced(workload: Workload, cfg: EngineConfig, seed: int):
+def run_traced(workload: Workload, cfg: EngineConfig, seed: int, params=None):
     """Replay ONE seed, recording every dispatched event in order.
 
     This is the debugging/bit-exact-replay path (SURVEY.md §7): run it on
     the CPU backend against a failure seed found by a TPU sweep — the
     integer-only engine guarantees the identical event sequence.
+    ``params`` is ONE candidate's (unbatched) spec-as-data pytree for
+    envelope-keyed workloads — ddmin shrink re-verifications replay
+    every candidate schedule through one compiled traced program.
     """
-    return _run_traced(workload, cfg, jnp.asarray(seed, jnp.int64))
+    return _run_traced(workload, cfg, jnp.asarray(seed, jnp.int64), params)

@@ -41,10 +41,11 @@ refcount with no array state beyond two counters.
 
 from __future__ import annotations
 
-from typing import NamedTuple, Tuple
+from typing import NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from . import net as enet
 from .core import Emits
@@ -211,12 +212,395 @@ class FixedFaults(NamedTuple):
     skew_den: int = 2
 
 
+# -- spec-as-data: the campaign envelope --------------------------------------
+#
+# A mutated campaign candidate used to be a NEW static spec and therefore
+# a NEW jit cache key: every candidate paid the full sweep compile
+# (~18-22 s on TPU) for ~0.4 s of run time. The envelope inverts that:
+# the STATIC jit key is only the per-family schedule CAPACITY (row
+# shapes), and the concrete spec rides in as traced data (``FaultParams``)
+# — one compiled sweep program serves every candidate the envelope covers.
+
+# fixed family order — matches ``_categories`` and the explore mutator's
+# ``_COUNT_FIELDS`` (explore/campaign.py)
+FAMILIES = (
+    "crashes", "partitions", "spikes", "losses", "pauses",
+    "aparts", "fsync_stalls", "power_fails", "skews",
+)
+N_FAMILIES = len(FAMILIES)
+_F_APART = FAMILIES.index("aparts")
+_F_FSYNC = FAMILIES.index("fsync_stalls")
+_F_SKEW = FAMILIES.index("skews")
+
+# (window, dur_lo, dur_hi, group) spec fields per family; group None =
+# the network-wide burst families (victim range [0, 1), like _categories)
+_FAMILY_FIELDS = (
+    ("crash_window_ns", "restart_lo_ns", "restart_hi_ns", "crash_group"),
+    ("part_window_ns", "part_lo_ns", "part_hi_ns", "part_group"),
+    ("spike_window_ns", "spike_dur_lo_ns", "spike_dur_hi_ns", None),
+    ("loss_window_ns", "loss_dur_lo_ns", "loss_dur_hi_ns", None),
+    ("pause_window_ns", "pause_lo_ns", "pause_hi_ns", "pause_group"),
+    ("apart_window_ns", "apart_lo_ns", "apart_hi_ns", "apart_group"),
+    ("fsync_window_ns", "fsync_lo_ns", "fsync_hi_ns", "fsync_group"),
+    ("power_window_ns", "power_lo_ns", "power_hi_ns", "power_group"),
+    ("skew_window_ns", "skew_lo_ns", "skew_hi_ns", "skew_group"),
+)
+# (on, off) action codes per family; the apart pair is resolved per
+# window from the victim draw's direction bit, exactly like _categories
+_FAMILY_ACTIONS = (
+    (F_CRASH, F_RESTART),
+    (F_PART, F_HEAL),
+    (F_SPIKE_ON, F_SPIKE_OFF),
+    (F_LOSS_ON, F_LOSS_OFF),
+    (F_PAUSE, F_RESUME),
+    ((F_PART_IN, F_PART_OUT), (F_HEAL_IN, F_HEAL_OUT)),
+    (F_FSYNC_STALL, F_FSYNC_OK),
+    (F_POWER_FAIL, F_RESTART),
+    (F_SKEW_ON, F_SKEW_OFF),
+)
+
+
+class FaultEnvelope(NamedTuple):
+    """The STATIC shape of a fault campaign — the jit cache key of the
+    spec-as-data path (docs/faults.md "Spec-as-data and the campaign
+    envelope").
+
+    ``maxima[f]`` is the padded window-pair capacity of family ``f`` (in
+    ``FAMILIES`` order); ``fixed`` is the row capacity for literal
+    ``FixedFaults`` schedules. Any concrete spec whose counts fit the
+    envelope compiles to ``FaultParams`` (``spec_to_params``) and runs
+    through the ONE sweep program compiled for this envelope — a mutated
+    campaign candidate, a differential-grid spec, or a shrink
+    re-verification of compatible width costs zero recompiles."""
+
+    maxima: Tuple[int, ...] = (0,) * N_FAMILIES
+    fixed: int = 0
+
+
+# static (it IS the jit key): contributes no traced leaves when it rides
+# inside a pytree like FaultRt-carrying workload state or a jit argument
+jax.tree_util.register_static(FaultEnvelope)
+
+
+class FaultRt(NamedTuple):
+    """The RUNTIME override scalars of one candidate spec — the traced
+    counterpart of the ``FaultSpec`` fields ``on_event``/``skewed_delay``
+    read at event time. Models on the envelope path carry one per lane in
+    their workload state and hand it to the interpreter in place of the
+    static spec (the reads are duck-typed: both carry the same names)."""
+
+    spike_lat_lo_ns: jnp.ndarray  # int64 ()
+    spike_lat_hi_ns: jnp.ndarray  # int64 ()
+    burst_loss_q32: jnp.ndarray  # uint32 ()
+    skew_num: jnp.ndarray  # int64 ()
+    skew_den: jnp.ndarray  # int64 ()
+
+
+class FaultParams(NamedTuple):
+    """One concrete fault campaign as DATA (a pytree of arrays) — what a
+    ``FaultEnvelope``-keyed program consumes instead of recompiling.
+
+    Per-family arrays are indexed in ``FAMILIES`` order; rows beyond
+    ``counts[f]`` are enable-masked out of the emit stream. ``fx_*``
+    carry a literal ``FixedFaults`` schedule padded to the envelope's
+    ``fixed`` capacity. Build with ``spec_to_params``; batch per lane
+    with ``tile_params``/``stack_params``."""
+
+    counts: jnp.ndarray  # int32[N_FAMILIES] actual window pairs
+    windows: jnp.ndarray  # int64[N_FAMILIES] start-draw window
+    dur_lo: jnp.ndarray  # int64[N_FAMILIES]
+    dur_hi: jnp.ndarray  # int64[N_FAMILIES]
+    vic_lo: jnp.ndarray  # int32[N_FAMILIES] resolved group lo
+    vic_hi: jnp.ndarray  # int32[N_FAMILIES] resolved group hi (exclusive)
+    fx_times: jnp.ndarray  # int64[fixed] literal schedule rows
+    fx_actions: jnp.ndarray  # int32[fixed]
+    fx_victims: jnp.ndarray  # int32[fixed]
+    fx_count: jnp.ndarray  # int32 () valid literal rows
+    rt: FaultRt
+
+
+def campaign_envelope(
+    *specs, mutation_cap: int = 0, fixed: int = 0
+) -> FaultEnvelope:
+    """The envelope covering every given ``FaultSpec`` plus headroom:
+    per-family capacity is the max over the specs' counts and
+    ``mutation_cap`` (the explore mutator passes its ``_MAX_PHASES``
+    clamp, so every reachable mutation of the corpus fits)."""
+    maxima = [mutation_cap] * N_FAMILIES
+    for spec in specs:
+        if isinstance(spec, FixedFaults):
+            fixed = max(fixed, len(spec.events))
+            continue
+        for i, f in enumerate(FAMILIES):
+            maxima[i] = max(maxima[i], getattr(spec, f))
+    return FaultEnvelope(maxima=tuple(maxima), fixed=fixed)
+
+
+def spec_to_params(spec, envelope: FaultEnvelope, num_nodes: int) -> FaultParams:
+    """Compile one concrete spec (``FaultSpec`` or ``FixedFaults``) to
+    the envelope's data layout — host-side numpy, so validation (group
+    resolution, capacity fit) happens eagerly, before any tracing.
+
+    The derivation consuming these params (``schedule_events_padded``)
+    produces the BIT-IDENTICAL ``(time_ns, action, victim)`` schedule
+    the static path produces for the same ``(spec, seed)`` — asserted
+    per family in tests/test_fault_params.py."""
+    counts = np.zeros((N_FAMILIES,), np.int32)
+    windows = np.ones((N_FAMILIES,), np.int64)
+    dur_lo = np.zeros((N_FAMILIES,), np.int64)
+    dur_hi = np.ones((N_FAMILIES,), np.int64)
+    vic_lo = np.zeros((N_FAMILIES,), np.int32)
+    vic_hi = np.ones((N_FAMILIES,), np.int32)
+    fx_times = np.zeros((envelope.fixed,), np.int64)
+    fx_actions = np.zeros((envelope.fixed,), np.int32)
+    fx_victims = np.zeros((envelope.fixed,), np.int32)
+    fx_count = np.int32(0)
+    if isinstance(spec, FixedFaults):
+        e = len(spec.events)
+        if e > envelope.fixed:
+            raise ValueError(
+                f"FixedFaults schedule of {e} events exceeds the "
+                f"envelope's fixed capacity {envelope.fixed}"
+            )
+        for i, (t, action, vic) in enumerate(spec.events):
+            if action not in ACTION_CODES:
+                raise ValueError(f"unknown fault action {action!r}")
+            if not 0 <= vic < num_nodes:
+                raise ValueError(
+                    f"victim {vic} outside [0, {num_nodes}) in fixed "
+                    f"schedule event {(t, action, vic)!r}"
+                )
+            fx_times[i] = t
+            fx_actions[i] = ACTION_CODES[action]
+            fx_victims[i] = vic
+        fx_count = np.int32(e)
+    else:
+        for i, (fam, fields) in enumerate(zip(FAMILIES, _FAMILY_FIELDS)):
+            count = getattr(spec, fam)
+            if count > envelope.maxima[i]:
+                raise ValueError(
+                    f"spec draws {count} {fam} windows but the envelope "
+                    f"caps the family at {envelope.maxima[i]}"
+                )
+            win_f, lo_f, hi_f, group_f = fields
+            counts[i] = count
+            windows[i] = getattr(spec, win_f)
+            dur_lo[i] = getattr(spec, lo_f)
+            dur_hi[i] = getattr(spec, hi_f)
+            if group_f is None:
+                vic_lo[i], vic_hi[i] = 0, 1
+            else:
+                # validate eagerly even for count-0 families, exactly
+                # like the static derivation's _resolve_group does
+                vic_lo[i], vic_hi[i] = _resolve_group(
+                    getattr(spec, group_f), num_nodes, fam
+                )
+    return FaultParams(
+        counts=counts,
+        windows=windows,
+        dur_lo=dur_lo,
+        dur_hi=dur_hi,
+        vic_lo=vic_lo,
+        vic_hi=vic_hi,
+        fx_times=fx_times,
+        fx_actions=fx_actions,
+        fx_victims=fx_victims,
+        fx_count=fx_count,
+        rt=FaultRt(
+            spike_lat_lo_ns=np.int64(spec.spike_lat_lo_ns),
+            spike_lat_hi_ns=np.int64(spec.spike_lat_hi_ns),
+            burst_loss_q32=np.uint32(spec.burst_loss_q32),
+            skew_num=np.int64(spec.skew_num),
+            skew_den=np.int64(spec.skew_den),
+        ),
+    )
+
+
+def tile_params(params: FaultParams, n: int) -> FaultParams:
+    """Broadcast ONE candidate's params to an ``n``-lane batch (every
+    sweep lane carries its candidate's params, so the candidate axis
+    vmaps exactly like the seed axis)."""
+    return jax.tree.map(
+        lambda a: np.broadcast_to(np.asarray(a), (n,) + np.shape(a)), params
+    )
+
+
+def stack_params(params_list) -> FaultParams:
+    """Stack K candidates' params into one batch, leading axis K."""
+    return jax.tree.map(lambda *ls: np.stack(ls), *params_list)
+
+
+def grid_params(params_list, lanes: int) -> FaultParams:
+    """The (candidate x seed) grid layout: each of the K candidates'
+    params tiled over ``lanes`` seed lanes, concatenated to one flat
+    ``K * lanes`` batch — candidate k owns lanes ``[k*lanes, (k+1)*lanes)``,
+    matching a seed vector built by ``np.tile(seed_range, K)``."""
+    return jax.tree.map(
+        lambda *ls: np.concatenate(
+            [np.broadcast_to(np.asarray(a), (lanes,) + np.shape(a)) for a in ls]
+        ),
+        *params_list,
+    )
+
+
+def runtime_spec(spec, frt):
+    """The spec VIEW the in-loop interpreter should read values from:
+    the static spec itself on the legacy path, the per-lane ``FaultRt``
+    carried in workload state on the envelope path. Models call this in
+    every fault-reading handler so both paths share one code line."""
+    return frt if isinstance(spec, FaultEnvelope) else spec
+
+
+def make_rt(spec, params: Optional[FaultParams]):
+    """The workload-state ``frt`` slot for a model config: the traced
+    override scalars on the envelope path, a leafless placeholder on the
+    legacy path (costs nothing in the loop carry)."""
+    if isinstance(spec, FaultEnvelope):
+        if params is None:
+            raise ValueError(
+                "workload config carries a FaultEnvelope; the sweep needs "
+                "per-lane FaultParams (pass params= through run_sweep — "
+                "build them with spec_to_params + tile_params)"
+            )
+        return params.rt
+    return ()
+
+
+# -- threefry at explicit counters (the padded derivation's RNG) -------------
+#
+# The engine pins ``jax_threefry_partitionable`` (engine/__init__.py), so
+# ``jax.random.bits(key, (s,), uint32)`` is element-wise in the counter:
+# bits[i] = lane0 ^ lane1 of threefry-2x32(key, (hi32(i), lo32(i))) —
+# independent of s. The padded derivation exploits exactly that: it
+# evaluates the hash at explicit indices (a RUNTIME function of the
+# candidate's actual window counts), reproducing the static path's draw
+# stream bit for bit from inside one compiled program of envelope shape.
+
+_THREEFRY_ROT = (13, 15, 26, 6, 17, 29, 16, 24)
+
+
+def _threefry2x32(k0, k1, x0, x1):
+    """Pure-jnp Threefry-2x32 (20 rounds), bit-identical to jax's
+    ``threefry2x32`` kernel (validated against ``jax.random.bits`` in
+    tests/test_fault_params.py)."""
+    k0 = jnp.asarray(k0, jnp.uint32)
+    k1 = jnp.asarray(k1, jnp.uint32)
+    ks2 = k0 ^ k1 ^ jnp.uint32(0x1BD11BDA)
+    x0 = jnp.asarray(x0, jnp.uint32) + k0
+    x1 = jnp.asarray(x1, jnp.uint32) + k1
+    ks = (k1, ks2, k0)
+
+    def rotl(v, r):
+        return (v << r) | (v >> (32 - r))
+
+    for i in range(5):
+        for j in range(4):
+            r = _THREEFRY_ROT[(i % 2) * 4 + j]
+            x0 = x0 + x1
+            x1 = rotl(x1, r) ^ x0
+        x0 = x0 + ks[i % 3]
+        x1 = x1 + ks[(i + 1) % 3] + jnp.uint32(i + 1)
+    return x0, x1
+
+
+def bits_at(key: jax.Array, idx):
+    """``jax.random.bits(key, (s,), uint32)[idx]`` for any ``s > idx``,
+    with RUNTIME ``idx`` — the primitive that lets one compiled program
+    reproduce the draw stream of every spec shape. Well-defined because
+    the engine pins the partitionable threefry counter scheme, under
+    which draw ``i`` is a pure function of ``(key, i)`` (validated
+    against ``jax.random.bits`` in tests/test_fault_params.py)."""
+    kd = jax.random.key_data(key)
+    idx = jnp.asarray(idx, jnp.uint32)
+    o0, o1 = _threefry2x32(kd[0], kd[1], jnp.zeros_like(idx), idx)
+    return o0 ^ o1
+
+
+def schedule_events_padded(
+    envelope: FaultEnvelope, params: FaultParams, num_nodes: int, key: jax.Array
+):
+    """The schedule derivation of the spec-as-data path: ``(times
+    int64[E], actions int32[E], victims int32[E], enables bool[E])``
+    with ``E = num_events(envelope)`` STATIC rows, of which exactly the
+    candidate's real events are enabled.
+
+    Contract: the enabled rows, in order, equal ``schedule_events(spec,
+    num_nodes, key)`` bit for bit (same draws, same pair order) — the
+    device↔host differential from PR 1 holds through the padded path
+    unchanged, and disabled rows never reach the queue (``push_many``
+    assigns slots to enabled emits only), so the engine dispatches the
+    identical event sequence."""
+    pmax = sum(envelope.maxima)
+    if pmax:
+        # static per-row family metadata (row j = r-th padded window of
+        # family fam[j]); runtime pair index = actual windows of earlier
+        # families + r, so active rows draw at the exact indices the
+        # dense static derivation would
+        fam = np.repeat(np.arange(N_FAMILIES), envelope.maxima)
+        row = np.concatenate([np.arange(m) for m in envelope.maxima])
+        base = jnp.concatenate(
+            [jnp.zeros((1,), jnp.int32), jnp.cumsum(params.counts)]
+        )
+        pair = base[fam] + jnp.asarray(row, jnp.int32)
+        active = jnp.asarray(row, jnp.int32) < params.counts[fam]
+        fkey = jax.random.fold_in(key, FAULT_STREAM)
+        # masked rows hash a harmless counter (their draws are never
+        # used — enables=False keeps them out of the queue entirely)
+        i3 = jnp.where(active, 3 * pair, 0)
+        r_start = bits_at(fkey, i3)
+        r_dur = bits_at(fkey, i3 + 1)
+        r_vic = bits_at(fkey, i3 + 2)
+
+        t0 = bounded(r_start, 0, params.windows[fam])
+        dur = bounded(r_dur, params.dur_lo[fam], params.dur_hi[fam])
+        vlo = params.vic_lo[fam]
+        vhi = params.vic_hi[fam]
+        directional = jnp.asarray(fam == _F_APART)
+        d = bounded(r_vic, 0, 2 * (vhi - vlo))
+        vic = jnp.where(
+            directional,
+            (vlo + (d >> 1)).astype(jnp.int32),
+            bounded(r_vic, vlo, vhi).astype(jnp.int32),
+        )
+        out_dir = directional & ((d & 1) == 1)
+        on_code = np.asarray(
+            [a if not isinstance(a, tuple) else a[0] for a, _ in _FAMILY_ACTIONS],
+            np.int32,
+        )
+        off_code = np.asarray(
+            [a if not isinstance(a, tuple) else a[0] for _, a in _FAMILY_ACTIONS],
+            np.int32,
+        )
+        on = jnp.where(out_dir, jnp.int32(F_PART_OUT), on_code[fam])
+        off = jnp.where(out_dir, jnp.int32(F_HEAL_OUT), off_code[fam])
+        # interleave (on, off) per pair — the static path's row order
+        times = jnp.stack([t0, t0 + dur], axis=1).reshape(2 * pmax)
+        actions = jnp.stack([on, off], axis=1).reshape(2 * pmax)
+        victims = jnp.stack([vic, vic], axis=1).reshape(2 * pmax)
+        enables = jnp.repeat(active, 2)
+    else:
+        times = jnp.zeros((0,), jnp.int64)
+        actions = jnp.zeros((0,), jnp.int32)
+        victims = jnp.zeros((0,), jnp.int32)
+        enables = jnp.zeros((0,), bool)
+    if envelope.fixed:
+        fx_on = jnp.arange(envelope.fixed, dtype=jnp.int32) < params.fx_count
+        times = jnp.concatenate([times, jnp.asarray(params.fx_times, jnp.int64)])
+        actions = jnp.concatenate([actions, jnp.asarray(params.fx_actions, jnp.int32)])
+        victims = jnp.concatenate([victims, jnp.asarray(params.fx_victims, jnp.int32)])
+        enables = jnp.concatenate([enables, fx_on])
+    return times, actions, victims, enables
+
+
 def num_events(spec) -> int:
     """Static event count of the compiled campaign (every ``FaultSpec``
     category contributes an on/off pair per window; a ``FixedFaults``
-    schedule is its literal length)."""
+    schedule is its literal length; a ``FaultEnvelope`` is its padded
+    capacity — the emit-stream SHAPE one compiled program serves)."""
     if isinstance(spec, FixedFaults):
         return len(spec.events)
+    if isinstance(spec, FaultEnvelope):
+        return 2 * sum(spec.maxima) + spec.fixed
     return 2 * (
         spec.crashes
         + spec.partitions
@@ -363,22 +747,50 @@ def schedule_events(spec, num_nodes: int, key: jax.Array):
 
 
 def compile_device(
-    spec,  # FaultSpec | FixedFaults
+    spec,  # FaultSpec | FixedFaults | FaultEnvelope (with params)
     num_nodes: int,
     key: jax.Array,
     fault_kind: int,
     payload_slots: int,
+    params: Optional[FaultParams] = None,
 ) -> Emits:
     """Compile the campaign into a fault event stream a model splices into
     its initial event set. Payload layout: ``(action, victim, t_lo, t_hi)``
     with ``t = t_hi << 31 | t_lo`` the exact scheduled deadline (both
-    halves non-negative int32, so no sign-wrap ambiguity)."""
+    halves non-negative int32, so no sign-wrap ambiguity).
+
+    A ``FaultEnvelope`` spec compiles the candidate carried in ``params``
+    through the padded derivation: the emit stream has the envelope's
+    STATIC row count with the unused rows enable-masked. The enabled
+    rows are COMPACTED to the front (stable, original order) before
+    packing: ``push_many`` maps emit index -> free-slot rank and
+    ``pop_min`` breaks equal-time ties by a slot-index hash, so only a
+    hole-free stream occupies the exact slots the dense static path's
+    would — compaction is what upgrades "same events" to "bit-identical
+    dispatch order" even on time ties (FixedFaults schedules place them
+    deliberately)."""
     if payload_slots < 4:
         raise ValueError(
             f"fault events need 4 payload slots (action, victim, t_lo, "
             f"t_hi); the workload has {payload_slots}"
         )
-    times, actions, victims = schedule_events(spec, num_nodes, key)
+    if isinstance(spec, FaultEnvelope):
+        if params is None:
+            raise ValueError(
+                "compiling a FaultEnvelope needs the candidate's "
+                "FaultParams (spec_to_params)"
+            )
+        times, actions, victims, enables = schedule_events_padded(
+            spec, params, num_nodes, key
+        )
+        order = jnp.argsort(~enables, stable=True)  # enabled first
+        times = times[order]
+        actions = actions[order]
+        victims = victims[order]
+        enables = enables[order]
+    else:
+        times, actions, victims = schedule_events(spec, num_nodes, key)
+        enables = jnp.ones((int(times.shape[0]),), bool)
     e = int(times.shape[0])
     pays = jnp.zeros((e, payload_slots), jnp.int32)
     if e:
@@ -390,7 +802,7 @@ def compile_device(
         times=times,
         kinds=jnp.full((e,), fault_kind, jnp.int32),
         pays=pays,
-        enables=jnp.ones((e,), bool),
+        enables=enables,
     )
 
 
@@ -472,13 +884,29 @@ def stalled(f: FaultState) -> jnp.ndarray:
 
 def can_skew(spec) -> bool:
     """Whether the (static, trace-time) spec can ever open a skew
-    window. Gates ``skewed_delay`` off entirely for skew-free specs."""
+    window. Gates ``skewed_delay`` off entirely for skew-free specs.
+    An envelope gates per CAMPAIGN: the identity optimization applies
+    iff no candidate the envelope covers can draw a skew window."""
     if isinstance(spec, FixedFaults):
         return any(a in ("skew_on", "skew_off") for _, a, _ in spec.events)
+    if isinstance(spec, FaultEnvelope):
+        return spec.maxima[_F_SKEW] > 0 or spec.fixed > 0
     return spec.skews > 0
 
 
-def skewed_delay(spec, f: FaultState, node, delay_ns):
+def can_stall(spec) -> bool:
+    """Whether the (static, trace-time) spec can ever open a slow-disk
+    window — the gate for model durability shadows (e.g. raft's, which
+    go width-0 for stall-free specs). Like ``can_skew``, an envelope
+    decides this once per campaign, not per candidate."""
+    if isinstance(spec, FixedFaults):
+        return any(a == "fsync_stall" for _, a, _ in spec.events)
+    if isinstance(spec, FaultEnvelope):
+        return spec.maxima[_F_FSYNC] > 0 or spec.fixed > 0
+    return spec.fsync_stalls > 0
+
+
+def skewed_delay(spec, f: FaultState, node, delay_ns, rt=None):
     """A timer interval as the (possibly skewed) victim's clock measures
     it: while ``node`` is inside a clock-skew window its timers stretch
     by ``spec.skew_num / spec.skew_den`` (both ``FaultSpec`` and
@@ -486,12 +914,17 @@ def skewed_delay(spec, f: FaultState, node, delay_ns):
     timer re-arm through this — the device half of the host tier's
     ``time.node_skew`` (docs/faults.md gray failures). Statically an
     identity when the spec draws no skew windows (``skew_cnt`` is
-    provably zero then), so the common case pays nothing."""
+    provably zero then), so the common case pays nothing.
+
+    ``rt`` supplies the ratio on the spec-as-data path (``spec`` is then
+    the envelope — the static gate — and the values are per-lane traced
+    scalars, ``runtime_spec``'s result)."""
     d = jnp.asarray(delay_ns, jnp.int64)
     if not can_skew(spec):
         return d
+    v = spec if rt is None else rt
     slow = get1(f.skew_cnt, node) > 0
-    return jnp.where(slow, d * spec.skew_num // spec.skew_den, d)
+    return jnp.where(slow, d * v.skew_num // v.skew_den, d)
 
 
 def on_event(

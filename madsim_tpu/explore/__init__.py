@@ -42,12 +42,16 @@ from .campaign import (  # noqa: F401
     run_campaign,
     spec_from_dict,
     spec_to_dict,
+    sweep_candidate_grid,
+    target_envelope,
+    use_legacy_spec_path,
 )
 from .fleet import checked_sweep_curve, sharded_campaign  # noqa: F401
 from .differential import (  # noqa: F401
     DifferentialConfig,
     TierOutcome,
     device_outcomes,
+    device_outcomes_grid,
     gate_specs,
     host_outcomes,
     run_differential,

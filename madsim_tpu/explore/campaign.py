@@ -30,12 +30,21 @@ gates this).
 from __future__ import annotations
 
 import json
+import os
 import random
-from typing import List, NamedTuple, Optional, Tuple
+from typing import List, NamedTuple, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ..engine.faults import FaultSpec, FixedFaults, prob_to_q32
+from ..engine.faults import (
+    FaultEnvelope,
+    FaultSpec,
+    FixedFaults,
+    campaign_envelope,
+    prob_to_q32,
+    spec_to_params,
+    tile_params,
+)
 from ..models._common import coverage_bit_count
 from .targets import Target
 
@@ -96,6 +105,14 @@ class CampaignConfig(NamedTuple):
     stop_after_failures: int = 0  # stop once this many seeds violate (0 = never)
     max_recorded_seeds: int = 8  # violating seeds listed per round record
     check_workers: int = 0  # process-pool size for history checking
+    # candidates swept per device launch (spec-as-data only): batch > 1
+    # generates that many candidates from the CURRENT corpus snapshot
+    # and sweeps them as ONE (candidate x seed) grid — retention still
+    # applies in candidate order, but parents within a block are drawn
+    # before the block's results land, so batch changes the (still
+    # deterministic) campaign trajectory; batch=1 is the exact serial
+    # semantics the byte-identity gates pin
+    batch: int = 1
 
 
 class CampaignResult(NamedTuple):
@@ -204,6 +221,30 @@ def spec_from_dict(d: dict):
     )
 
 
+def use_legacy_spec_path() -> bool:
+    """The pre-refactor compile-per-candidate path, kept behind an env
+    toggle for one round so ``scripts/check_determinism.sh`` can
+    byte-diff a spec-as-data campaign report against it
+    (``MADSIM_CAMPAIGN_LEGACY=1``)."""
+    return os.environ.get("MADSIM_CAMPAIGN_LEGACY", "") == "1"
+
+
+def target_envelope(target: Target, *specs, fixed: int = 0) -> FaultEnvelope:
+    """The campaign envelope for ``target``: covers every given spec
+    plus the mutator's ``_MAX_PHASES`` clamp, so every candidate any
+    campaign round can generate fits ONE compiled sweep program
+    (docs/explore.md "The campaign envelope")."""
+    return campaign_envelope(*specs, mutation_cap=_MAX_PHASES, fixed=fixed)
+
+
+def _candidate_params(target: Target, spec, envelope: FaultEnvelope, lanes: int):
+    """Per-lane FaultParams for one candidate over a ``lanes``-seed
+    range (host numpy — validation is eager, tracing sees arrays)."""
+    return tile_params(
+        spec_to_params(spec, envelope, target.num_nodes), lanes
+    )
+
+
 def _sweep_candidate(
     target: Target,
     spec,
@@ -211,12 +252,28 @@ def _sweep_candidate(
     round_dir: Optional[str],
     mesh=None,
     on_chunk=None,
+    envelope: Optional[FaultEnvelope] = None,
 ) -> dict:
     """Run one candidate's sweep over the pinned seed range; returns the
     merged summary dict (coverage_map + violating_seeds included).
     ``mesh`` shards the whole pipeline (sweep, screen, summary) over the
-    device mesh; the summary bytes are mesh-size-invariant."""
-    workload, ecfg = target.build(spec)
+    device mesh; the summary bytes are mesh-size-invariant.
+
+    With ``envelope`` the candidate rides in as spec-as-data: the
+    workload is built from the ENVELOPE (the jit cache key) and the
+    concrete spec becomes per-lane ``FaultParams``, so every candidate
+    after the first reuses the one compiled sweep/summary pipeline —
+    the compile-per-candidate tax this module used to pay per round is
+    gone. The summary bytes are identical either way (the padded
+    schedule derivation is bit-exact; tests/test_fault_params.py)."""
+    if envelope is None:
+        workload, ecfg = target.build(spec)
+        params = None
+    else:
+        workload, ecfg = target.build(envelope)
+        params = _candidate_params(
+            target, spec, envelope, ccfg.seeds_per_round
+        )
     if workload.cover is None or workload.cover_bits == 0:
         raise ValueError(
             f"target {target.name!r} workload defines no coverage signal "
@@ -281,15 +338,101 @@ def _sweep_candidate(
         return run_sweep_sharded_pipelined(
             workload, ecfg, seeds, target.summarize, mesh=mesh,
             host_work=host_work, screen=screen_fn, chunk_size=chunk_size,
-            ckpt_dir=round_dir, on_chunk=on_chunk,
+            ckpt_dir=round_dir, on_chunk=on_chunk, params=params,
         )
     from ..engine.checkpoint import run_sweep_pipelined
 
     return run_sweep_pipelined(
         workload, ecfg, seeds, target.summarize, host_work=host_work,
         screen=screen_fn, chunk_size=chunk_size, ckpt_dir=round_dir,
-        on_chunk=on_chunk,
+        on_chunk=on_chunk, params=params,
     )
+
+
+def sweep_candidate_grid(
+    target: Target,
+    specs: Sequence,
+    ccfg: CampaignConfig,
+    envelope: FaultEnvelope,
+    mesh=None,
+) -> List[dict]:
+    """Sweep K candidates as ONE (candidate x seed) device grid and
+    return each candidate's summary dict — identical values to K calls
+    of ``_sweep_candidate`` over the same pinned seed range.
+
+    This is the batched half of the spec-as-data tentpole: a campaign
+    round of small per-candidate sweeps (256 seeds each) under-occupies
+    the device, so K candidates stack their per-lane ``FaultParams``
+    into one flat ``K * seeds_per_round`` launch (vmapping the candidate
+    axis alongside the seed axis — to the engine they are just more
+    lanes). Per-candidate summaries fall out by slicing the flat final
+    state with ``core.lane_slice`` — one compiled slice program and one
+    compiled summary program serve every candidate, so a warmed grid
+    runs with ZERO XLA compilations regardless of K."""
+    from ..engine.core import lane_slice, run_in_chunks, run_sweep
+    from ..engine.faults import grid_params
+
+    workload, ecfg = target.build(envelope)
+    if workload.cover is None or workload.cover_bits == 0:
+        raise ValueError(
+            f"target {target.name!r} workload defines no coverage signal "
+            "(Workload.cover/cover_bits); without it the campaign loop "
+            "degenerates to unguided mutation of the base spec"
+        )
+    s = ccfg.seeds_per_round
+    k = len(specs)
+    seeds = np.tile(
+        np.arange(ccfg.seed0, ccfg.seed0 + s, dtype=np.int64), k
+    )
+    params = grid_params(
+        [spec_to_params(spec, envelope, target.num_nodes) for spec in specs],
+        s,
+    )
+    if mesh is not None:
+        from ..parallel.mesh import run_sweep_sharded
+
+        run_chunk = lambda chunk, pchunk: run_sweep_sharded(  # noqa: E731
+            workload, ecfg, chunk, mesh, params=pchunk
+        )
+        multiple = int(mesh.devices.size)
+    else:
+        run_chunk = lambda chunk, pchunk: run_sweep(  # noqa: E731
+            workload, ecfg, chunk, params=pchunk
+        )
+        multiple = 1
+    # chunk granule rounded up to mesh divisibility like every other
+    # sharded driver (run_in_chunks' multiple= pads only the
+    # single-chunk path)
+    chunk_size = -(-max(ccfg.chunk_size, s) // multiple) * multiple
+    final = run_in_chunks(
+        run_chunk, seeds, chunk_size, multiple=multiple, params=params,
+    )
+
+    summaries: List[dict] = []
+    for i in range(k):
+        lane = lane_slice(final, s, i * s)
+        summary = dict(target.summarize(lane))
+        if target.hist_spec is not None:
+            # the serial pipeline's host-phase machinery, per candidate
+            # block: the device screen clears the boring lanes and the
+            # WGL checker fans the suspects over the process pool
+            from ..oracle.check import violating_seeds
+
+            vio = np.asarray(
+                violating_seeds(
+                    lane, target.hist_spec, screen="auto",
+                    workers=ccfg.check_workers,
+                )
+            )
+        else:
+            vio = np.asarray(target.violating(lane))
+        summary["violating_seeds"] = [
+            int(x) for x in vio[: ccfg.max_recorded_seeds]
+        ]
+        if "violations" not in summary:
+            summary["violations"] = int(vio.size)
+        summaries.append(summary)
+    return summaries
 
 
 def run_campaign(
@@ -320,9 +463,22 @@ def run_campaign(
     whole campaign one unit of work spanning all chips, and the JSONL
     report BYTE-IDENTICAL to the same campaign on any other mesh size
     (docs/multichip.md). ``on_chunk(lo=, k=, summary=)`` fires per
-    merged chunk (time-to-first-violation instrumentation)."""
-    import os
+    merged chunk (time-to-first-violation instrumentation).
 
+    Spec-as-data is the default sweep path: the campaign envelope
+    (``target_envelope``) is derived ONCE from the base spec + mutator
+    clamps, the workload compiles once for the envelope shape, and
+    every candidate rides in as per-lane ``FaultParams`` — a warmed
+    campaign runs its remaining rounds with zero XLA compilations
+    (``make explore-smoke`` counter-asserts this). Report bytes are
+    unchanged vs the pre-refactor compile-per-candidate path, which
+    survives one more round behind ``MADSIM_CAMPAIGN_LEGACY=1``.
+    ``ccfg.batch > 1`` additionally sweeps that many candidates per
+    device launch as one (candidate x seed) grid
+    (``sweep_candidate_grid``); grid blocks skip per-round sweep
+    checkpointing and per-chunk ``on_chunk`` callbacks (``ckpt_dir``
+    and ``on_chunk`` apply to serial rounds only — a grid block is one
+    launch, not a chunk stream)."""
     rng = random.Random(ccfg.campaign_seed)
     corpus: List[object] = []
     records: List[dict] = []
@@ -336,23 +492,30 @@ def run_campaign(
         "base_spec": spec_to_dict(base_spec),
     }
 
-    for r in range(ccfg.rounds):
+    envelope = None if use_legacy_spec_path() else target_envelope(
+        target, base_spec
+    )
+
+    def gen(r: int):
+        """Candidate r: the base spec for round 0, a seeded mutation of
+        a drawn corpus parent after. In batch mode the block's
+        candidates draw against the corpus SNAPSHOT — retention from
+        earlier rounds of the block hasn't landed, so both the parent
+        draws and the rng stream diverge from the serial trajectory
+        (deterministically; see ``CampaignConfig.batch``)."""
         if r == 0:
-            parent, spec = None, base_spec
-        else:
-            parent = rng.randrange(len(corpus)) if corpus else None
-            spec = mutate_spec(
-                corpus[parent] if parent is not None else base_spec,
-                rng,
-                ccfg.mutations_hi,
-            )
-        round_dir = (
-            os.path.join(ckpt_dir, f"round_{r:04d}") if ckpt_dir else None
-        )
-        summary = _sweep_candidate(
-            target, spec, ccfg, round_dir, mesh=mesh, on_chunk=on_chunk
+            return None, base_spec
+        parent = rng.randrange(len(corpus)) if corpus else None
+        return parent, mutate_spec(
+            corpus[parent] if parent is not None else base_spec,
+            rng,
+            ccfg.mutations_hi,
         )
 
+    def absorb(r: int, parent, spec, summary: dict) -> bool:
+        """Fold one candidate's summary into corpus/coverage/records;
+        True = the failure budget is spent (stop the campaign)."""
+        nonlocal global_map
         cand_map = [int(w) for w in summary.get("coverage_map", [])]
         if len(global_map) < len(cand_map):
             global_map = global_map + [0] * (len(cand_map) - len(global_map))
@@ -386,11 +549,43 @@ def run_campaign(
                 "events_total": int(summary.get("events_total", 0)),
             }
         )
-        if (
+        return bool(
             ccfg.stop_after_failures
             and len(failures) >= ccfg.stop_after_failures
-        ):
-            break
+        )
+
+    stop = False
+    r = 0
+    while r < ccfg.rounds and not stop:
+        if ccfg.batch > 1 and envelope is not None:
+            block = [
+                gen(r + i) for i in range(min(ccfg.batch, ccfg.rounds - r))
+            ]
+            # a ragged tail block is padded back to the full batch width
+            # (repeat the last candidate, discard its extra summaries):
+            # the grid's lane count is a jit shape, and a one-off tail
+            # shape would pay a fresh sweep compile for nothing
+            specs = [spec for _, spec in block]
+            specs += [specs[-1]] * (ccfg.batch - len(block))
+            summaries = sweep_candidate_grid(
+                target, specs, ccfg, envelope, mesh=mesh,
+            )[: len(block)]
+            for (parent, spec), summary in zip(block, summaries):
+                stop = absorb(r, parent, spec, summary)
+                r += 1
+                if stop:
+                    break
+        else:
+            parent, spec = gen(r)
+            round_dir = (
+                os.path.join(ckpt_dir, f"round_{r:04d}") if ckpt_dir else None
+            )
+            summary = _sweep_candidate(
+                target, spec, ccfg, round_dir, mesh=mesh, on_chunk=on_chunk,
+                envelope=envelope,
+            )
+            stop = absorb(r, parent, spec, summary)
+            r += 1
 
     if report_path is not None:
         with open(report_path, "w") as f:

@@ -27,18 +27,19 @@ narrowed spec redraws its schedule, so it is not seed-stable — the
 ``FixedFaults`` triple is the reproducing artifact; the narrowed spec is
 a better search start.
 
-Cost model: each ddmin candidate is a distinct ``FixedFaults`` config,
-and configs are jit cache keys — every candidate replay COMPILES its own
-traced program (seconds on CPU), which dominates the shrink wall-clock
-and is why ``max_tests`` defaults low. Candidate workloads and their
-compiled programs are also RETAINED for the process lifetime (the
-models' ``memoized_workload`` cache and the jit cache are both
-unbounded), so a long-running process shrinking many failures grows
-memory with every distinct candidate. Fault schedules are short (a few
-dozen events), so ddmin's test count stays small; feeding the literal
-schedule in as runtime arrays instead of a static config would amortize
-both costs but needs an engine-level dynamic-init channel — noted as
-future work, not worth the surface today.
+Cost model: ddmin candidates replay through the spec-as-data channel
+(engine/faults.py): the traced program compiles ONCE per
+``FaultEnvelope(fixed=W)`` width — ``W`` is the original schedule
+length rounded up to a power of two, so every candidate subset of every
+comparably-sized failure shares it — and each candidate rides in as
+runtime ``FaultParams`` (bit-identical replay to a static ``FixedFaults``
+config; tests/test_fault_params.py). This replaced the
+compile-per-candidate cost model that used to dominate shrink
+wall-clock (one jit cache entry per distinct candidate config, seconds
+each on CPU); ``MADSIM_CAMPAIGN_LEGACY=1`` keeps that path for one
+round (``explore.campaign.use_legacy_spec_path``), and ``max_tests``
+still bounds the replay count because each replay costs a real traced
+run either way.
 """
 
 from __future__ import annotations
@@ -148,18 +149,42 @@ def shrink(
     _, trace = ecore.run_traced(workload, ecfg, seed)
     full = extract_fault_schedule(trace, target.fault_kind)
 
+    # spec-as-data replay channel: one traced program per envelope WIDTH
+    # (len(full) rounded up to a power of two — candidates are subsets,
+    # and comparably-sized failures share the program), each candidate
+    # fed in as runtime FaultParams; the legacy toggle keeps the
+    # compile-per-candidate path for one byte-diff round
+    from .campaign import use_legacy_spec_path
+
+    env = None
+    if not use_legacy_spec_path():
+        from ..engine.faults import FaultEnvelope, spec_to_params
+
+        width = 4
+        while width < len(full):
+            width *= 2
+        env = FaultEnvelope(fixed=width)
+
     # memoize replays by event tuple: ddmin's regranulation can revisit a
     # subset, and the final verification is always the last accepted
-    # test — each replay costs a compile (see the module cost note), so
-    # none repeats and only real replays burn the max_tests budget
+    # test — each replay costs a real traced run (see the module cost
+    # note), so none repeats and only real replays burn the max_tests
+    # budget
     replayed: dict = {}
 
     def run(events: List[FaultEvent]) -> Optional[Failure]:
         key = tuple(events)
         if key not in replayed:
-            replayed[key] = triage_seed(
-                target, to_fixed(spec, events), seed, history=history
-            )
+            fixed = to_fixed(spec, events)
+            if env is None:
+                replayed[key] = triage_seed(
+                    target, fixed, seed, history=history
+                )
+            else:
+                replayed[key] = triage_seed(
+                    target, env, seed, history=history,
+                    params=spec_to_params(fixed, env, target.num_nodes),
+                )
         return replayed[key]
 
     def reproduces(events: List[FaultEvent]) -> bool:

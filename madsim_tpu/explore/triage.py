@@ -48,7 +48,9 @@ class Failure(NamedTuple):
     fingerprint: str  # the dedupe key: name:flavor:kind:node
 
 
-def _triage_history(target: Target, workload, ecfg, seed: int) -> Optional[Failure]:
+def _triage_history(
+    target: Target, workload, ecfg, seed: int, params=None
+) -> Optional[Failure]:
     """History-oracle triage: decode the seed's recorded op history and
     fingerprint the op that ends the first non-linearizable prefix.
     ``step`` is that op's index in the decoded history (not a dispatch
@@ -75,7 +77,13 @@ def _triage_history(target: Target, workload, ecfg, seed: int) -> Optional[Failu
             f"target {target.name!r} workload records no op history "
             "(Workload.record/hist_slots); there is nothing to check"
         )
-    final = ecore.run_sweep(workload, ecfg, jnp.asarray([seed], jnp.int64))
+    if params is not None:
+        from ..engine.faults import tile_params
+
+        params = tile_params(params, 1)
+    final = ecore.run_sweep(
+        workload, ecfg, jnp.asarray([seed], jnp.int64), params=params
+    )
     result = check_history(decode_seed(final, 0), target.hist_spec)
     if result.ok:
         return None
@@ -92,23 +100,30 @@ def _triage_history(target: Target, workload, ecfg, seed: int) -> Optional[Failu
 
 
 def triage_seed(
-    target: Target, faults, seed: int, history: bool = False
+    target: Target, faults, seed: int, history: bool = False, params=None
 ) -> Optional[Failure]:
     """Re-run one seed traced and locate its first violating event.
 
     Returns None when the seed does not violate under ``faults`` (the
     workload's probe never leaves zero — or, with ``history=True``, the
     decoded op history checks linearizable) — the caller's signal that a
-    candidate schedule no longer reproduces."""
+    candidate schedule no longer reproduces.
+
+    ``faults`` may be a ``FaultEnvelope`` with the concrete candidate in
+    ``params`` (engine/faults.py spec-as-data): the replay is
+    bit-identical to the static-spec path, but every candidate of the
+    envelope's width reuses ONE compiled traced program — the shrinker's
+    ddmin loop replays dozens of schedules for one compile instead of
+    one compile each."""
     workload, ecfg = target.build(faults)
     if history:
-        return _triage_history(target, workload, ecfg, seed)
+        return _triage_history(target, workload, ecfg, seed, params=params)
     if workload.probe is None:
         raise ValueError(
             f"target {target.name!r} workload defines no probe; triage "
             "needs the per-step violation flavor run_traced records"
         )
-    _, trace = ecore.run_traced(workload, ecfg, seed)
+    _, trace = ecore.run_traced(workload, ecfg, seed, params=params)
     fired = np.asarray(trace["fired"])
     probe = np.asarray(trace["probe"])
     hits = np.nonzero(fired & (probe != 0))[0]

@@ -133,9 +133,13 @@ class EtcdConfig(NamedTuple):
     # are recorded (lease keys are mutated by server-internal expiry,
     # which has no client-observed invoke/complete to record).
     hist_slots: int = 0
-    # full declarative fault campaign (engine/faults.FaultSpec); None =
-    # derive a client-partition spec from the legacy fields above
-    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
+    # full declarative fault campaign (engine/faults.FaultSpec), a
+    # literal schedule, or a FaultEnvelope (spec-as-data: the concrete
+    # candidate rides in as per-lane FaultParams); None = derive a
+    # client-partition spec from the legacy fields above
+    faults: Optional[
+        Union[efaults.FaultSpec, efaults.FixedFaults, efaults.FaultEnvelope]
+    ] = None
 
     @property
     def num_nodes(self) -> int:
@@ -154,6 +158,12 @@ def fault_spec(cfg: EtcdConfig) -> efaults.FaultSpec:
         part_hi_ns=cfg.part_hi_ns,
         part_group=(1, -1),
     )
+
+
+def _rt(cfg: EtcdConfig, w: "EtcdState"):
+    """Runtime spec view for the in-loop interpreter: the static spec on
+    the legacy path, this lane's traced ``FaultRt`` on the envelope path."""
+    return efaults.runtime_spec(fault_spec(cfg), w.frt)
 
 
 class EtcdState(NamedTuple):
@@ -201,6 +211,10 @@ class EtcdState(NamedTuple):
     parts: jnp.ndarray  # int32 partitions applied
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
+    # spec-as-data (engine/faults.py): this lane's runtime override
+    # scalars (FaultRt) on the envelope path; a leafless () on the legacy
+    # path
+    frt: object
 
 
 def _pay(*vals) -> jnp.ndarray:
@@ -256,6 +270,7 @@ def _on_op_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     interval = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[5], cfg.op_lo_ns, cfg.op_hi_ns),
+        rt=_rt(cfg, w),
     )
     emits = _emits2(
         (t, K_MSG, msg, sent),
@@ -284,6 +299,7 @@ def _on_keepalive_timer(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     interval = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[2], cfg.keepalive_lo_ns, cfg.keepalive_hi_ns),
+        rt=_rt(cfg, w),
     )
     # opid -1: lease traffic carries no history opid, so its reply can
     # never alias a pending KV op's completion record
@@ -314,7 +330,8 @@ def _on_msg(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     # the expiry deadline is a SERVER timer: a skewed server clock
     # stretches the TTL countdown (keys linger — the gray failure)
     new_exp = now + efaults.skewed_delay(
-        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.ttl_ns
+        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.ttl_ns,
+        rt=_rt(cfg, w),
     )
     lease_on2 = set1(w.lease_on, lease, True, is_lease)
     lease_exp2 = set1(w.lease_exp, lease, new_exp, is_lease)
@@ -457,7 +474,7 @@ def _on_fault(cfg: EtcdConfig, w: EtcdState, now, pay, rand):
     action, victim = pay[0], pay[1]
     base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
     links2, f2, _edges = efaults.on_event(
-        fault_spec(cfg), base, w.links, w.fstate, action, victim
+        _rt(cfg, w), base, w.links, w.fstate, action, victim
     )
     part_like = (
         (action == efaults.F_PART)
@@ -544,7 +561,7 @@ def _record(cfg: EtcdConfig, wb: EtcdState, wa: EtcdState, now, kind, pay):
     return rec, inv_en | ok_en
 
 
-def _init(cfg: EtcdConfig, key):
+def _init(cfg: EtcdConfig, key, params=None):
     nc = cfg.num_clients
     if cfg.num_keys < nc:
         raise ValueError("num_keys must cover one lease key per client")
@@ -589,6 +606,7 @@ def _init(cfg: EtcdConfig, key):
         parts=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
+        frt=efaults.make_rt(fault_spec(cfg), params),
     )
     times = jnp.zeros((ninit,), jnp.int64)
     kinds = jnp.zeros((ninit,), jnp.int32)
@@ -606,7 +624,8 @@ def _init(cfg: EtcdConfig, key):
         pays = pays.at[2 * c + 1].set(_pay(c))
     # fault campaign: the shared compiler's event stream, spliced in
     fe = efaults.compile_device(
-        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS,
+        params=params,
     )
     return w, Emits(
         times=jnp.concatenate([times, fe.times]),

@@ -116,7 +116,9 @@ class KafkaConfig(NamedTuple):
     hist_slots: int = 0
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a broker-crash spec from the legacy fields above
-    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
+    faults: Optional[
+        Union[efaults.FaultSpec, efaults.FixedFaults, efaults.FaultEnvelope]
+    ] = None
 
     @property
     def num_nodes(self) -> int:
@@ -135,6 +137,12 @@ def fault_spec(cfg: KafkaConfig) -> efaults.FaultSpec:
         restart_hi_ns=cfg.restart_hi_ns,
         crash_group=(BROKER, BROKER + 1),
     )
+
+
+def _rt(cfg: KafkaConfig, w: "KafkaState"):
+    """Runtime spec view for the in-loop interpreter: the static spec on
+    the legacy path, this lane's traced ``FaultRt`` on the envelope path."""
+    return efaults.runtime_spec(fault_spec(cfg), w.frt)
 
 
 class KafkaState(NamedTuple):
@@ -171,6 +179,10 @@ class KafkaState(NamedTuple):
     crash_count: jnp.ndarray  # int32 crashes that hit a live broker
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
+    # spec-as-data (engine/faults.py): this lane's runtime override
+    # scalars (FaultRt) on the envelope path; a leafless () on the legacy
+    # path
+    frt: object
 
 
 def _pay(*vals) -> jnp.ndarray:
@@ -214,6 +226,7 @@ def _on_produce_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     interval = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[2], cfg.produce_lo_ns, cfg.produce_hi_ns),
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -246,6 +259,7 @@ def _on_fetch_timer(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     interval = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[2], cfg.fetch_lo_ns, cfg.fetch_hi_ns),
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -430,7 +444,8 @@ def _on_flush(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     bcast = (times, jnp.full((n,), K_MSG, jnp.int32), pays, enables)
 
     flush_dt = efaults.skewed_delay(
-        fault_spec(cfg), w.fstate, jnp.int32(BROKER), cfg.flush_interval_ns
+        fault_spec(cfg), w.fstate, jnp.int32(BROKER), cfg.flush_interval_ns,
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -470,7 +485,7 @@ def _on_fault(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
     action, victim = pay[0], pay[1]
     base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
     links2, f2, e = efaults.on_event(
-        fault_spec(cfg), base, w.links, w.fstate, action, victim
+        _rt(cfg, w), base, w.links, w.fstate, action, victim
     )
     at_broker = victim == BROKER
     crashed = e.crashed & at_broker
@@ -494,7 +509,8 @@ def _on_fault(cfg: KafkaConfig, w: KafkaState, now, pay, rand):
         crash_count=w.crash_count + jnp.where(crashed, 1, 0),
     )
     flush_dt = efaults.skewed_delay(
-        fault_spec(cfg), f2, jnp.int32(BROKER), cfg.flush_interval_ns
+        fault_spec(cfg), f2, jnp.int32(BROKER), cfg.flush_interval_ns,
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -595,7 +611,7 @@ def _record(cfg: KafkaConfig, wb: KafkaState, wa: KafkaState, now, kind, pay):
     return rec, p_sent | f_sent | acked | matched
 
 
-def _init(cfg: KafkaConfig, key):
+def _init(cfg: KafkaConfig, key, params=None):
     np_, nc = cfg.num_producers, cfg.num_consumers
     ninit = np_ + nc + 1
     rand = jax.random.bits(
@@ -631,6 +647,7 @@ def _init(cfg: KafkaConfig, key):
         crash_count=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
+        frt=efaults.make_rt(fault_spec(cfg), params),
     )
     times = jnp.zeros((ninit,), jnp.int64)
     kinds = jnp.zeros((ninit,), jnp.int32)
@@ -652,7 +669,8 @@ def _init(cfg: KafkaConfig, key):
     pays = pays.at[i].set(_pay(0))
     # fault campaign: the shared compiler's event stream, spliced in
     fe = efaults.compile_device(
-        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS,
+        params=params,
     )
     return w, Emits(
         times=jnp.concatenate([times, fe.times]),

@@ -128,9 +128,14 @@ class RaftConfig(NamedTuple):
     # differential harness checks against oracle.specs.ElectionSpec on
     # both tiers (explore/differential.py).
     hist_slots: int = 0
-    # full declarative fault campaign (engine/faults.FaultSpec); None =
+    # full declarative fault campaign (engine/faults.FaultSpec), a
+    # literal schedule, or a FaultEnvelope — the spec-as-data path, where
+    # the jit key is the envelope SHAPE and the concrete candidate rides
+    # in as per-lane FaultParams (run_sweep's ``params=``); None =
     # derive a crash-storm spec from the legacy fields above
-    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
+    faults: Optional[
+        Union[efaults.FaultSpec, efaults.FixedFaults, efaults.FaultEnvelope]
+    ] = None
 
 
 def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
@@ -146,19 +151,22 @@ def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
     )
 
 
+def _rt(cfg: RaftConfig, w: "RaftState"):
+    """Runtime spec view for the in-loop interpreter: the static spec on
+    the legacy path, this lane's traced ``FaultRt`` on the envelope path."""
+    return efaults.runtime_spec(fault_spec(cfg), w.frt)
+
+
 def _shadow_nodes(cfg: RaftConfig) -> int:
     """Width of the durability-shadow planes: ``num_nodes`` iff the
     (static, jit-cache-key) spec can open a slow-disk window. Without
     fsync stalls the shadow provably equals the live durable state after
     every event, so the planes go width-0 and every shadow write and
     crash rollback is gated off at trace time — the no-stall common case
-    (all pre-gray configs, the headline benchmarks) pays nothing."""
-    spec = fault_spec(cfg)
-    if isinstance(spec, efaults.FixedFaults):
-        stalls = any(a == "fsync_stall" for _, a, _ in spec.events)
-    else:
-        stalls = spec.fsync_stalls > 0
-    return cfg.num_nodes if stalls else 0
+    (all pre-gray configs, the headline benchmarks) pays nothing. A
+    ``FaultEnvelope`` decides this once per CAMPAIGN (any candidate it
+    covers could draw a stall window), not per candidate."""
+    return cfg.num_nodes if efaults.can_stall(fault_spec(cfg)) else 0
 
 
 class RaftState(NamedTuple):
@@ -211,6 +219,10 @@ class RaftState(NamedTuple):
     cmd_giveups: jnp.ndarray  # int32 commands that hit the retry cap
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
+    # spec-as-data (engine/faults.py): this lane's runtime override
+    # scalars (FaultRt) on the envelope path; an empty, leafless () on
+    # the legacy path — zero loop-carry cost there
+    frt: object
 
 
 def _pay(*vals) -> jnp.ndarray:
@@ -337,6 +349,7 @@ def _on_election_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     timeout = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[2 * cfg.num_nodes], cfg.election_lo_ns, cfg.election_hi_ns),
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -358,7 +371,9 @@ def _on_heartbeat_timer(cfg: RaftConfig, w: RaftState, now, pay, rand):
     bcast, sent, delivered = _broadcast(
         cfg, w, now, node, rand, valid, _append_pays(cfg, w, node, term)
     )
-    hb = efaults.skewed_delay(fault_spec(cfg), w.fstate, node, cfg.heartbeat_ns)
+    hb = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, node, cfg.heartbeat_ns, rt=_rt(cfg, w)
+    )
     emits = _emits(
         cfg,
         bcast,
@@ -519,7 +534,9 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
     )
     attempt_reply = (grant | is_ap) & live
     send_reply = attempt_reply & rdeliver
-    hb = efaults.skewed_delay(fault_spec(cfg), w.fstate, dst, cfg.heartbeat_ns)
+    hb = efaults.skewed_delay(
+        fault_spec(cfg), w.fstate, dst, cfg.heartbeat_ns, rt=_rt(cfg, w)
+    )
     extra_time = jnp.where(won, now + hb, rt)
     extra_kind = jnp.where(won, jnp.int32(K_HEARTBEAT), jnp.int32(K_MSG))
     extra_pay = jnp.where(won, _pay(dst, get1(w2.lepoch, dst)), reply_pay)
@@ -530,6 +547,7 @@ def _on_msg(cfg: RaftConfig, w: RaftState, now, pay, rand):
         bounded(
             rand[2 * cfg.num_nodes + 2], cfg.election_lo_ns, cfg.election_hi_ns
         ),
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         cfg,
@@ -567,7 +585,7 @@ def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
     action, victim = pay[0], pay[1]
     base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
     links2, f2, e = efaults.on_event(
-        fault_spec(cfg), base, w.links, w.fstate, action, victim
+        _rt(cfg, w), base, w.links, w.fstate, action, victim
     )
     crashed, restarted, resumed = e.crashed, e.restarted, e.resumed
     stopped = crashed | e.paused  # the node's event chains must die
@@ -623,9 +641,12 @@ def _on_fault(cfg: RaftConfig, w: RaftState, now, pay, rand):
     timeout = efaults.skewed_delay(
         fault_spec(cfg), f2, victim,
         bounded(rand[0], cfg.election_lo_ns, cfg.election_hi_ns),
+        rt=_rt(cfg, w),
     )
     still_leader = get1(w2.role, victim) == LEADER  # only a resumed leader
-    hb = efaults.skewed_delay(fault_spec(cfg), f2, victim, cfg.heartbeat_ns)
+    hb = efaults.skewed_delay(
+        fault_spec(cfg), f2, victim, cfg.heartbeat_ns, rt=_rt(cfg, w)
+    )
     emits = _emits(
         cfg,
         _no_bcast(cfg),
@@ -765,7 +786,7 @@ def _handle(cfg: RaftConfig, w: RaftState, now, kind, pay, rand):
     return w2, emits
 
 
-def _init(cfg: RaftConfig, key):
+def _init(cfg: RaftConfig, key, params=None):
     n = cfg.num_nodes
     ninit = n + cfg.commands
     # init draws live in their own counter namespace, disjoint from the
@@ -812,6 +833,7 @@ def _init(cfg: RaftConfig, key):
         cmd_giveups=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
+        frt=efaults.make_rt(fault_spec(cfg), params),
     )
     times = jnp.zeros((ninit,), jnp.int64)
     kinds = jnp.zeros((ninit,), jnp.int32)
@@ -830,7 +852,9 @@ def _init(cfg: RaftConfig, key):
         kinds = kinds.at[n + k].set(K_CMD)
         pays = pays.at[n + k].set(_pay(target, 0))
     # fault campaign: the shared compiler's event stream, spliced in
-    fe = efaults.compile_device(fault_spec(cfg), n, key, K_FAULT, PAYLOAD_SLOTS)
+    fe = efaults.compile_device(
+        fault_spec(cfg), n, key, K_FAULT, PAYLOAD_SLOTS, params=params
+    )
     return w, Emits(
         times=jnp.concatenate([times, fe.times]),
         kinds=jnp.concatenate([kinds, fe.kinds]),

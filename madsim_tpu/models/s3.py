@@ -129,7 +129,9 @@ class S3Config(NamedTuple):
     bug_ack_before_durable: bool = False
     # full declarative fault campaign (engine/faults.FaultSpec); None =
     # derive a server-crash spec from the legacy fields above
-    faults: Optional[Union[efaults.FaultSpec, efaults.FixedFaults]] = None
+    faults: Optional[
+        Union[efaults.FaultSpec, efaults.FixedFaults, efaults.FaultEnvelope]
+    ] = None
 
     @property
     def num_nodes(self) -> int:
@@ -148,6 +150,12 @@ def fault_spec(cfg: S3Config) -> efaults.FaultSpec:
         restart_hi_ns=cfg.restart_hi_ns,
         crash_group=(SERVER, SERVER + 1),
     )
+
+
+def _rt(cfg: S3Config, w: "S3State"):
+    """Runtime spec view for the in-loop interpreter: the static spec on
+    the legacy path, this lane's traced ``FaultRt`` on the envelope path."""
+    return efaults.runtime_spec(fault_spec(cfg), w.frt)
 
 
 class S3State(NamedTuple):
@@ -192,6 +200,10 @@ class S3State(NamedTuple):
     crash_count: jnp.ndarray  # int32 crashes that hit a live server
     msgs_sent: jnp.ndarray  # int32
     msgs_delivered: jnp.ndarray  # int32
+    # spec-as-data (engine/faults.py): this lane's runtime override
+    # scalars (FaultRt) on the envelope path; a leafless () on the legacy
+    # path
+    frt: object
 
 
 def _pay(*vals) -> jnp.ndarray:
@@ -248,6 +260,7 @@ def _on_op_timer(cfg: S3Config, w: S3State, now, pay, rand):
     interval = efaults.skewed_delay(
         fault_spec(cfg), w.fstate, node,
         bounded(rand[2], cfg.op_lo_ns, cfg.op_hi_ns),
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         (t, K_MSG, _pay(SERVER, mtype, node, a, b), send),
@@ -485,7 +498,8 @@ def _on_flush(cfg: S3Config, w: S3State, now, pay, rand):
         len_dur=jnp.where(do_flush, w.len_com, w.len_dur),
     )
     flush_dt = efaults.skewed_delay(
-        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.flush_interval_ns
+        fault_spec(cfg), w.fstate, jnp.int32(SERVER), cfg.flush_interval_ns,
+        rt=_rt(cfg, w),
     )
     emits = _emits(
         (now + flush_dt, K_FLUSH, _pay(gen), valid),
@@ -509,7 +523,7 @@ def _on_fault(cfg: S3Config, w: S3State, now, pay, rand):
     action, victim = pay[0], pay[1]
     base = efaults.NetBase(cfg.lat_lo_ns, cfg.lat_hi_ns, cfg.loss_q32)
     links2, f2, e = efaults.on_event(
-        fault_spec(cfg), base, w.links, w.fstate, action, victim
+        _rt(cfg, w), base, w.links, w.fstate, action, victim
     )
     at_server = victim == SERVER
     crashed = e.crashed & at_server
@@ -551,7 +565,7 @@ def _handle(cfg: S3Config, w: S3State, now, kind, pay, rand):
     return jax.lax.switch(kind, branches, w, now, pay, rand)
 
 
-def _init(cfg: S3Config, key):
+def _init(cfg: S3Config, key, params=None):
     nc, k = cfg.num_clients, cfg.num_keys
     ninit = nc + 1
     rand = jax.random.bits(
@@ -594,6 +608,7 @@ def _init(cfg: S3Config, key):
         crash_count=jnp.zeros((), jnp.int32),
         msgs_sent=jnp.zeros((), jnp.int32),
         msgs_delivered=jnp.zeros((), jnp.int32),
+        frt=efaults.make_rt(fault_spec(cfg), params),
     )
     times = jnp.zeros((ninit,), jnp.int64)
     kinds = jnp.zeros((ninit,), jnp.int32)
@@ -612,7 +627,8 @@ def _init(cfg: S3Config, key):
         enables = enables.at[i].set(False)
     # fault campaign: the shared compiler's event stream, spliced in
     fe = efaults.compile_device(
-        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS
+        fault_spec(cfg), cfg.num_nodes, key, K_FAULT, PAYLOAD_SLOTS,
+        params=params,
     )
     return w, Emits(
         times=jnp.concatenate([times, fe.times]),

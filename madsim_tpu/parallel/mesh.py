@@ -20,6 +20,7 @@ from typing import Callable, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..engine.core import EngineConfig, EngineState, Workload, init_sweep, step_one
@@ -130,8 +131,17 @@ def _sharded_run(workload: Workload, cfg: EngineConfig, mesh: Mesh):
     )
 
 
+def shard_params(mesh: Mesh, params):
+    """Place a per-lane spec-as-data pytree (engine/faults.py) sharded
+    over the mesh's seed axis — every leaf's leading axis is the lane
+    batch, exactly like ``shard_state``'s contract."""
+    sharding = NamedSharding(mesh, P(SEED_AXIS))
+    return jax.device_put(params, sharding)
+
+
 def run_sweep_sharded(
-    workload: Workload, cfg: EngineConfig, seeds, mesh: Optional[Mesh] = None
+    workload: Workload, cfg: EngineConfig, seeds, mesh: Optional[Mesh] = None,
+    params=None,
 ) -> EngineState:
     """Run a seed sweep sharded over a device mesh; bit-identical to the
     single-device ``engine.run_sweep`` for the same seeds.
@@ -140,7 +150,12 @@ def run_sweep_sharded(
     ``while_loop`` whose cond psums the live count every step, so all
     devices terminate together. Flat because a nested device loop costs
     ~9x per step on TPU (engine/core.py ``drive``); the per-step psum
-    rides ICI and is noise next to a step."""
+    rides ICI and is noise next to a step.
+
+    ``params`` is per-lane spec-as-data (``engine.run_sweep``'s
+    contract), sharded alongside the seed axis — its leaves are traced,
+    so sweeping a new candidate reuses the one compiled sharded
+    program."""
     if mesh is None:
         mesh = seed_mesh()
     seeds = shard_seeds(mesh, seeds)
@@ -149,7 +164,10 @@ def run_sweep_sharded(
     # core._init shares run_sweep's trace cache
     from ..engine.core import _init
 
-    state = _init(workload, cfg, seeds)
+    if params is None:
+        state = _init(workload, cfg, seeds)
+    else:
+        state = _init(workload, cfg, seeds, shard_params(mesh, params))
     return _sharded_run(workload, cfg, mesh)(state)
 
 
@@ -159,6 +177,7 @@ def run_sweep_sharded_chunked(
     seeds,
     mesh: Optional[Mesh] = None,
     chunk_per_device: int = 16384,
+    params=None,
 ) -> EngineState:
     """Pod-scale composition of the two scaling axes: the seed batch is
     sharded over the mesh AND run as sequential fixed-size chunks of one
@@ -179,11 +198,20 @@ def run_sweep_sharded_chunked(
     if mesh is None:
         mesh = seed_mesh()
     n_dev = mesh.devices.size
+    if params is None:
+        run_chunk = lambda chunk: run_sweep_sharded(  # noqa: E731
+            workload, cfg, chunk, mesh
+        )
+    else:
+        run_chunk = lambda chunk, pchunk: run_sweep_sharded(  # noqa: E731
+            workload, cfg, chunk, mesh, params=pchunk
+        )
     return run_in_chunks(
-        lambda chunk: run_sweep_sharded(workload, cfg, chunk, mesh),
+        run_chunk,
         seeds,
         chunk_per_device * n_dev,
         multiple=n_dev,
+        params=params,
     )
 
 
@@ -247,6 +275,7 @@ def run_sweep_sharded_pipelined(
     stop_after: Optional[int] = None,
     resume_from: Optional[Tuple[EngineState, dict]] = None,
     on_chunk: Optional[Callable] = None,
+    params=None,
 ) -> dict:
     """The pipelined checked-sweep driver lifted onto the mesh: chunked
     device sweeps run sharded over all devices (``run_sweep_sharded``),
@@ -282,10 +311,23 @@ def run_sweep_sharded_pipelined(
     n_dev = int(mesh.devices.size)
     if chunk_size is None:
         if chunk_per_device is None:
-            chunk_per_device = pick_chunk_size(workload, cfg)
+            one_lane = (
+                None
+                if params is None
+                else jax.tree.map(lambda a: np.asarray(a)[0], params)
+            )
+            chunk_per_device = pick_chunk_size(workload, cfg, params=one_lane)
         chunk_size = chunk_per_device * n_dev
     chunk_size = -(-chunk_size // n_dev) * n_dev  # mesh divisibility
 
+    if params is None:
+        run_chunk = lambda chunk: run_sweep_sharded(  # noqa: E731
+            workload, cfg, chunk, mesh
+        )
+    else:
+        run_chunk = lambda chunk, pchunk: run_sweep_sharded(  # noqa: E731
+            workload, cfg, chunk, mesh, params=pchunk
+        )
     return run_sweep_pipelined(
         workload,
         cfg,
@@ -297,10 +339,11 @@ def run_sweep_sharded_pipelined(
         ckpt_dir=ckpt_dir,
         stop_after=stop_after,
         resume_from=resume_from,
-        run_chunk=lambda chunk: run_sweep_sharded(workload, cfg, chunk, mesh),
+        run_chunk=run_chunk,
         resume_chunk=lambda state: resume_sweep_sharded(
             workload, cfg, state, mesh
         ),
         pad_multiple=n_dev,
         on_chunk=on_chunk,
+        params=params,
     )

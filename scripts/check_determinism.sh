@@ -84,16 +84,22 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
 
   # explore leg: two campaign runs of one campaign seed must emit
   # byte-identical JSONL reports (no shrink — this leg checks the
-  # campaign loop + coverage accounting, cheaply). The demo exits
-  # nonzero when its tiny budget finds no violation — expected here;
-  # only a MISSING report means the campaign itself crashed.
-  for r in a b; do
-    JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
-      --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
-      --report "$out/$r.jsonl" >"$out/$r.log" 2>&1 || true
-  done
+  # campaign loop + coverage accounting, cheaply). The second run pins
+  # the SPEC-AS-DATA contract too: it runs the pre-refactor
+  # compile-per-candidate path (MADSIM_CAMPAIGN_LEGACY=1, kept for one
+  # round — docs/faults.md "Spec-as-data"), so the byte-compare asserts
+  # the envelope/FaultParams path reproduces the legacy report exactly.
+  # The demo exits nonzero when its tiny budget finds no violation —
+  # expected here; only a MISSING report means the campaign crashed.
+  JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
+    --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
+    --report "$out/a.jsonl" >"$out/a.log" 2>&1 || true
+  JAX_PLATFORMS=cpu MADSIM_CAMPAIGN_LEGACY=1 "${PY:-python}" \
+    scripts/explore_demo.py \
+    --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
+    --report "$out/b.jsonl" >"$out/b.log" 2>&1 || true
   if [ -s "$out/a.jsonl" ] && cmp -s "$out/a.jsonl" "$out/b.jsonl"; then
-    echo "determinism gate: OK (two campaign runs, byte-identical reports)"
+    echo "determinism gate: OK (campaign spec-as-data == legacy path, byte-identical reports)"
   else
     echo "determinism gate: FAILED — campaign reports differ or are empty" >&2
     diff "$out/a.jsonl" "$out/b.jsonl" >&2 || true
@@ -187,11 +193,15 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
   # processes — a small matched grid here; the full 200-seed tolerance
   # gate runs as `make differential-smoke`. Tolerance verdicts on this
   # tiny grid are not the point (|| true); only the report bytes are.
-  for r in da db; do
-    JAX_PLATFORMS=cpu "${PY:-python}" scripts/differential_demo.py \
-      --seeds 32 --sim-seconds 1.5 --specs 2 \
-      --report "$out/$r.json" >"$out/$r.log" 2>&1 || true
-  done
+  # The db run takes the legacy compile-per-spec device path, so the
+  # compare also pins spec-as-data grid == legacy, byte for byte.
+  JAX_PLATFORMS=cpu "${PY:-python}" scripts/differential_demo.py \
+    --seeds 32 --sim-seconds 1.5 --specs 2 \
+    --report "$out/da.json" >"$out/da.log" 2>&1 || true
+  JAX_PLATFORMS=cpu MADSIM_CAMPAIGN_LEGACY=1 "${PY:-python}" \
+    scripts/differential_demo.py \
+    --seeds 32 --sim-seconds 1.5 --specs 2 \
+    --report "$out/db.json" >"$out/db.log" 2>&1 || true
   if [ -s "$out/da.json" ] && cmp -s "$out/da.json" "$out/db.json"; then
     echo "determinism gate: OK (two differential runs, byte-identical reports)"
   else

@@ -37,11 +37,17 @@ def main() -> None:
                     help="per-round sweep checkpoints (resumable campaigns)")
     ap.add_argument("--no-shrink", action="store_true",
                     help="stop after the campaign (the cheap determinism leg)")
+    ap.add_argument("--assert-zero-recompile", action="store_true",
+                    help="warm the envelope program with a one-round "
+                         "campaign, then FAIL unless the full campaign "
+                         "runs with 0 XLA compilations (the spec-as-data "
+                         "contract, docs/faults.md)")
     args = ap.parse_args()
 
     import time
 
     from madsim_tpu import explore
+    from madsim_tpu.engine.compiles import count_compiles
     from madsim_tpu.engine.faults import FaultSpec
     from madsim_tpu.models._common import coverage_bit_count
 
@@ -59,16 +65,34 @@ def main() -> None:
         campaign_seed=args.campaign_seed,
         stop_after_failures=1,
     )
-    result = explore.run_campaign(
-        target, bland, ccfg, report_path=args.report, ckpt_dir=args.ckpt_dir
-    )
+    if args.assert_zero_recompile:
+        # one round of the same campaign compiles every program the full
+        # run needs (envelope-keyed sweep, summary, pipeline glue) —
+        # every later candidate is data, not a new jit key
+        explore.run_campaign(target, bland, ccfg._replace(rounds=1))
+    with count_compiles() as compiles:
+        result = explore.run_campaign(
+            target, bland, ccfg, report_path=args.report,
+            ckpt_dir=args.ckpt_dir,
+        )
     out = {
         "metric": "explore_demo",
         "rounds_run": len(result.records),
         "corpus_size": len(result.corpus),
         "coverage_bits": coverage_bit_count(result.coverage_map),
         "failures_found": len(result.failures),
+        # XLA compilations the campaign itself performed (0 after the
+        # --assert-zero-recompile warm-up; without the warm-up the first
+        # round's compiles land here — engine/compiles.py)
+        "compiles_in_campaign": compiles.count,
     }
+    if args.assert_zero_recompile and compiles.count != 0:
+        print(
+            f"explore demo: campaign recompiled {compiles.count}x after "
+            "warm-up — the spec-as-data zero-recompile contract is broken",
+            file=sys.stderr,
+        )
+        sys.exit(1)
     if result.failures:
         spec, seed = result.failures[0]
         # triage each seed under the spec it was found with (failures can

@@ -32,11 +32,9 @@ forced CPU host mesh (madsim_tpu._cpu_mesh_env).
 from __future__ import annotations
 
 import json
-import logging
 import os
 import sys
 import time
-from contextlib import contextmanager
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -44,39 +42,13 @@ import jax
 import jax.numpy as jnp
 
 from madsim_tpu.engine import core
+from madsim_tpu.engine.compiles import count_compiles
 from madsim_tpu.models import raft
 from madsim_tpu.models._common import merge_summaries
 
 # env-overridable so smoke runs can exercise the multi-chunk + ragged
 # paths without paying for 16k-lane compiles
 CHUNK = int(os.environ.get("MADSIM_SWEEP_CHUNK", 16384))
-
-
-class _CompileCounter(logging.Handler):
-    """Counts finished XLA compilations surfaced by ``jax.log_compiles``
-    — the honest program-reuse measurement: a ragged final chunk that
-    recompiles anything shows up here, self-reported shape bookkeeping
-    does not count."""
-
-    def __init__(self):
-        super().__init__(level=logging.WARNING)
-        self.count = 0
-
-    def emit(self, record):
-        if "Finished XLA compilation" in record.getMessage():
-            self.count += 1
-
-
-@contextmanager
-def count_compiles():
-    handler = _CompileCounter()
-    logger = logging.getLogger("jax")
-    logger.addHandler(handler)
-    try:
-        with jax.log_compiles(True):
-            yield handler
-    finally:
-        logger.removeHandler(handler)
 
 
 def main() -> None:

@@ -1,0 +1,390 @@
+"""Spec-as-data fault campaigns (engine/faults.py FaultEnvelope/FaultParams).
+
+The contract under test (docs/faults.md "Spec-as-data and the campaign
+envelope"): a concrete spec compiled to runtime ``FaultParams`` and run
+through the ONE program of its ``FaultEnvelope`` produces the
+BIT-IDENTICAL ``(time_ns, action, victim)`` schedule — and therefore
+bit-identical sweeps, campaign reports, differential outcomes and shrink
+artifacts — as the static compile-per-spec path, while a warmed campaign
+of mutated candidates performs ZERO XLA compilations.
+"""
+
+import os
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from madsim_tpu import explore
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine import faults as efaults
+from madsim_tpu.engine.compiles import count_compiles
+from madsim_tpu.models import etcd, raft
+from madsim_tpu.replay import amnesia_raft_config
+
+# one window pair in EVERY family (gray families included), all windows
+# inside a 3 s horizon
+FULL_SPEC = efaults.FaultSpec(
+    crashes=2,
+    crash_window_ns=1_500_000_000,
+    restart_lo_ns=100_000_000,
+    restart_hi_ns=400_000_000,
+    partitions=2,
+    part_window_ns=1_500_000_000,
+    part_lo_ns=200_000_000,
+    part_hi_ns=600_000_000,
+    spikes=1,
+    losses=1,
+    pauses=1,
+    aparts=2,
+    apart_window_ns=1_200_000_000,
+    fsync_stalls=1,
+    power_fails=1,
+    skews=1,
+)
+
+NODES = 5
+
+
+def _padded_equals_dense(spec, envelope, num_nodes=NODES, seed=1234):
+    key = jax.random.key(seed)
+    td, ad, vd = efaults.schedule_events(spec, num_nodes, key)
+    params = efaults.spec_to_params(spec, envelope, num_nodes)
+    tp, ap, vp, en = efaults.schedule_events_padded(
+        envelope, params, num_nodes, key
+    )
+    en = np.asarray(en)
+    assert int(en.sum()) == int(td.shape[0])
+    np.testing.assert_array_equal(np.asarray(tp)[en], np.asarray(td))
+    np.testing.assert_array_equal(np.asarray(ap)[en], np.asarray(ad))
+    np.testing.assert_array_equal(np.asarray(vp)[en], np.asarray(vd))
+
+
+def test_bits_at_matches_jax_random_bits():
+    # the padded derivation's RNG primitive: draw i of the partitionable
+    # threefry stream as a pure function of (key, i), bit-for-bit what
+    # jax.random.bits(key, (s,), uint32)[i] returns for any s
+    for seed in (0, 7, 0xDEAD):
+        key = jax.random.key(seed)
+        ref = np.asarray(jax.random.bits(key, (257,), dtype=jnp.uint32))
+        got = np.asarray(
+            jax.vmap(lambda i, k=key: efaults.bits_at(k, i))(
+                jnp.arange(257, dtype=jnp.uint32)
+            )
+        )
+        np.testing.assert_array_equal(ref, got)
+
+
+@pytest.mark.parametrize("family", efaults.FAMILIES)
+def test_schedule_equivalence_per_family(family):
+    # each family alone, padded into an envelope with headroom in EVERY
+    # family: the enabled rows must be the dense derivation bit for bit
+    spec = efaults.FaultSpec(**{family: 2})
+    env = efaults.campaign_envelope(spec, mutation_cap=4)
+    for seed in (0, 3, 99):
+        _padded_equals_dense(spec, env, seed=seed)
+
+
+def test_schedule_equivalence_full_spec():
+    env = efaults.campaign_envelope(FULL_SPEC, mutation_cap=6)
+    for seed in (0, 1, 42, 1 << 40):
+        _padded_equals_dense(FULL_SPEC, env, seed=seed)
+
+
+def test_schedule_equivalence_fixed_faults():
+    fx = efaults.FixedFaults(
+        events=(
+            (100_000, "crash", 1),
+            (200_000, "restart", 1),
+            (200_000, "fsync_stall", 2),  # deliberate time tie
+            (300_000, "skew_on", 0),
+            (400_000, "part_in", 3),
+        )
+    )
+    env = efaults.FaultEnvelope(fixed=12)
+    _padded_equals_dense(fx, env)
+    # and the whole emit stream through compile_device: enabled rows
+    # compact to the front, so slots (and thus tie-breaks) match the
+    # dense path exactly
+    key = jax.random.key(5)
+    params = efaults.spec_to_params(fx, env, NODES)
+    dense = efaults.compile_device(fx, NODES, key, 7, 4)
+    padded = efaults.compile_device(env, NODES, key, 7, 4, params=params)
+    en = np.asarray(padded.enables)
+    k = int(en.sum())
+    assert k == len(fx.events) and en[:k].all(), "enabled rows not compacted"
+    np.testing.assert_array_equal(
+        np.asarray(padded.times)[:k], np.asarray(dense.times)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(padded.pays)[:k], np.asarray(dense.pays)
+    )
+
+
+def test_envelope_rejects_oversized_spec():
+    env = efaults.campaign_envelope(efaults.FaultSpec(crashes=1))
+    with pytest.raises(ValueError, match="envelope caps"):
+        efaults.spec_to_params(efaults.FaultSpec(crashes=2), env, NODES)
+    with pytest.raises(ValueError, match="fixed capacity"):
+        efaults.spec_to_params(
+            efaults.FixedFaults(events=((1, "crash", 0),)), env, NODES
+        )
+
+
+def test_envelope_static_gating():
+    # gating is decided once per campaign envelope, not per candidate
+    env = efaults.campaign_envelope(efaults.FaultSpec(skews=1))
+    assert efaults.can_skew(env) and not efaults.can_stall(env)
+    env = efaults.campaign_envelope(efaults.FaultSpec(fsync_stalls=1))
+    assert efaults.can_stall(env) and not efaults.can_skew(env)
+    assert not efaults.can_skew(efaults.FaultEnvelope())
+
+
+def _raft_pair(spec, env, seeds):
+    base_cfg, _ = amnesia_raft_config()
+    kw = dict(time_limit_ns=1_500_000_000, max_steps=15_000)
+    cfg_d = base_cfg._replace(faults=spec)
+    dense = ecore.run_sweep(
+        raft.workload(cfg_d), raft.engine_config(cfg_d, **kw), seeds
+    )
+    cfg_e = base_cfg._replace(faults=env)
+    params = efaults.tile_params(
+        efaults.spec_to_params(spec, env, base_cfg.num_nodes), len(seeds)
+    )
+    padded = ecore.run_sweep(
+        raft.workload(cfg_e), raft.engine_config(cfg_e, **kw), seeds,
+        params=params,
+    )
+    return raft.sweep_summary(dense), raft.sweep_summary(padded)
+
+
+def test_sweep_summary_identical_raft():
+    # end to end through the engine: the envelope sweep (durability
+    # shadows ON for the whole campaign, FaultRt in the loop carry) must
+    # reproduce the static path's summary exactly
+    spec = FULL_SPEC._replace(aparts=1, crashes=3)
+    env = efaults.campaign_envelope(spec, mutation_cap=6)
+    seeds = np.arange(48, dtype=np.int64)
+    s_dense, s_padded = _raft_pair(spec, env, seeds)
+    assert s_dense == s_padded
+
+
+def test_sweep_summary_identical_etcd():
+    spec = efaults.FaultSpec(
+        partitions=2, part_window_ns=1_200_000_000, part_group=(1, -1),
+        skews=1,
+    )
+    env = efaults.campaign_envelope(spec, mutation_cap=4)
+    cfg_d = etcd.EtcdConfig(faults=spec)
+    cfg_e = etcd.EtcdConfig(faults=env)
+    kw = dict(time_limit_ns=1_500_000_000, max_steps=15_000)
+    seeds = np.arange(32, dtype=np.int64)
+    dense = ecore.run_sweep(
+        etcd.workload(cfg_d), etcd.engine_config(cfg_d, **kw), seeds
+    )
+    params = efaults.tile_params(
+        efaults.spec_to_params(spec, env, cfg_e.num_nodes), len(seeds)
+    )
+    padded = ecore.run_sweep(
+        etcd.workload(cfg_e), etcd.engine_config(cfg_e, **kw), seeds,
+        params=params,
+    )
+    assert etcd.sweep_summary(dense) == etcd.sweep_summary(padded)
+
+
+def test_run_traced_identical_through_envelope():
+    # the shrink channel: a FixedFaults candidate replayed as params
+    # through a width-8 envelope dispatches the identical event sequence
+    target = explore.amnesia_raft_target(
+        time_limit_ns=1_000_000_000, max_steps=8_000
+    )
+    fx = efaults.FixedFaults(
+        events=((300_000_000, "crash", 0), (500_000_000, "restart", 0))
+    )
+    wl_d, ecfg_d = target.build(fx)
+    _, trace_d = ecore.run_traced(wl_d, ecfg_d, 3)
+    env = efaults.FaultEnvelope(fixed=8)
+    wl_e, ecfg_e = target.build(env)
+    _, trace_e = ecore.run_traced(
+        wl_e, ecfg_e, 3,
+        params=efaults.spec_to_params(fx, env, target.num_nodes),
+    )
+    for k in sorted(trace_d):
+        np.testing.assert_array_equal(
+            np.asarray(trace_d[k]), np.asarray(trace_e[k]), err_msg=k
+        )
+
+
+def _campaign_fixture():
+    target = explore.amnesia_raft_target(
+        time_limit_ns=1_000_000_000, max_steps=8_000
+    )
+    base = efaults.FaultSpec(
+        crashes=2,
+        crash_window_ns=800_000_000,
+        restart_lo_ns=50_000_000,
+        restart_hi_ns=200_000_000,
+    )
+    return target, base
+
+
+def test_campaign_report_bytes_identical_to_legacy(tmp_path):
+    # the hard byte-identity constraint: spec-as-data (default) vs the
+    # pre-refactor compile-per-candidate path (MADSIM_CAMPAIGN_LEGACY=1)
+    # for the same campaign seed
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(
+        rounds=3, seeds_per_round=32, campaign_seed=11
+    )
+    p_data = tmp_path / "data.jsonl"
+    p_legacy = tmp_path / "legacy.jsonl"
+    explore.run_campaign(target, base, ccfg, report_path=str(p_data))
+    os.environ["MADSIM_CAMPAIGN_LEGACY"] = "1"
+    try:
+        assert explore.use_legacy_spec_path()
+        explore.run_campaign(target, base, ccfg, report_path=str(p_legacy))
+    finally:
+        del os.environ["MADSIM_CAMPAIGN_LEGACY"]
+    assert p_data.read_bytes() == p_legacy.read_bytes()
+
+
+def test_warmed_campaign_zero_compiles():
+    # the acceptance contract: >= 16 mutated candidates, 0 XLA
+    # compilations in the timed region once the envelope program is warm
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(
+        rounds=17, seeds_per_round=32, campaign_seed=2
+    )
+    explore.run_campaign(target, base, ccfg._replace(rounds=1))  # warm
+    with count_compiles() as c:
+        result = explore.run_campaign(target, base, ccfg)
+    assert len(result.records) == 17
+    assert c.count == 0, f"{c.count} XLA compilations in a warmed campaign"
+
+
+def test_grid_summaries_match_serial():
+    # the batched (candidate x seed) grid returns the same per-candidate
+    # summary dicts as serial spec-as-data sweeps of the same seed range
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(seeds_per_round=32)
+    env = explore.target_envelope(target, base)
+    rng = random.Random(3)
+    specs = [base] + [explore.mutate_spec(base, rng) for _ in range(4)]
+    grid = explore.sweep_candidate_grid(target, specs, ccfg, env)
+    for spec, got in zip(specs, grid):
+        want = explore.campaign._sweep_candidate(
+            target, spec, ccfg, None, envelope=env
+        )
+        assert got == want
+
+
+def test_warmed_grid_zero_compiles():
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(seeds_per_round=32)
+    env = explore.target_envelope(target, base)
+    rng = random.Random(4)
+
+    def fresh(k):
+        return [explore.mutate_spec(base, rng) for _ in range(k)]
+
+    explore.sweep_candidate_grid(target, fresh(16), ccfg, env)  # warm
+    with count_compiles() as c:
+        explore.sweep_candidate_grid(target, fresh(16), ccfg, env)
+    assert c.count == 0, f"{c.count} XLA compilations in a warmed grid"
+
+
+def test_batched_campaign_runs_and_is_deterministic(tmp_path):
+    # batch > 1 is a different (documented) trajectory but still a pure
+    # function of the campaign seed
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(
+        rounds=5, seeds_per_round=32, campaign_seed=6, batch=4
+    )
+    pa, pb = tmp_path / "a.jsonl", tmp_path / "b.jsonl"
+    ra = explore.run_campaign(target, base, ccfg, report_path=str(pa))
+    rb = explore.run_campaign(target, base, ccfg, report_path=str(pb))
+    assert len(ra.records) == 5
+    assert pa.read_bytes() == pb.read_bytes()
+    # round 0 of any batch mode is the base spec, retained
+    assert ra.records[0]["spec"] == explore.spec_to_dict(base)
+    assert ra.records[0]["retained"]
+
+
+def test_differential_grid_matches_legacy_outcomes():
+    dcfg = explore.DifferentialConfig(seeds=16, sim_seconds=1.0)
+    specs = explore.gate_specs()
+    grid = explore.device_outcomes_grid(specs, dcfg)
+    for spec, got in zip(specs, grid):
+        assert got == explore.device_outcomes(spec, dcfg)
+
+
+def test_shrink_identical_through_envelope():
+    # ddmin re-verification through the fixed-width envelope returns the
+    # same minimal artifact as the compile-per-candidate path
+    target, base = _campaign_fixture()
+    ccfg = explore.CampaignConfig(
+        rounds=8, seeds_per_round=64, campaign_seed=1, stop_after_failures=1
+    )
+    result = explore.run_campaign(target, base, ccfg)
+    if not result.failures:
+        pytest.skip("tiny campaign budget found no failure on this config")
+    spec, seed = result.failures[0]
+    got = explore.shrink(target, spec, seed, max_tests=24)
+    os.environ["MADSIM_CAMPAIGN_LEGACY"] = "1"
+    try:
+        want = explore.shrink(target, spec, seed, max_tests=24)
+    finally:
+        del os.environ["MADSIM_CAMPAIGN_LEGACY"]
+    assert (got is None) == (want is None)
+    if got is not None:
+        assert got.schedule == want.schedule
+        assert got.fingerprint == want.fingerprint
+        assert got.tests == want.tests
+
+
+def test_params_digest_distinguishes_candidates():
+    from madsim_tpu.engine.checkpoint import params_digest
+
+    env = efaults.campaign_envelope(FULL_SPEC, mutation_cap=6)
+    a = efaults.spec_to_params(FULL_SPEC, env, NODES)
+    b = efaults.spec_to_params(
+        FULL_SPEC._replace(crashes=1), env, NODES
+    )
+    assert params_digest(a) == params_digest(a)
+    assert params_digest(a) != params_digest(b)
+
+
+def test_chunked_and_pipelined_params_match_flat():
+    # the chunk drivers slice/edge-pad per-lane params exactly like the
+    # seeds: a 3-chunk ragged sweep equals the one-shot sweep per lane
+    target, base = _campaign_fixture()
+    env = explore.target_envelope(target, base)
+    wl, ecfg = target.build(env)
+    n = 40  # 2 full 16-lane chunks + one ragged 8-lane tail
+    seeds = np.arange(n, dtype=np.int64)
+    params = efaults.tile_params(
+        efaults.spec_to_params(base, env, target.num_nodes), n
+    )
+    flat = ecore.run_sweep(wl, ecfg, seeds, params=params)
+    chunked = ecore.run_sweep_chunked(
+        wl, ecfg, seeds, chunk_size=16, params=params
+    )
+    for a, b in zip(jax.tree.leaves(flat), jax.tree.leaves(chunked)):
+        if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
+            a, b = jax.random.key_data(a), jax.random.key_data(b)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    from madsim_tpu.engine.checkpoint import run_sweep_pipelined
+
+    piped = run_sweep_pipelined(
+        wl, ecfg, seeds, target.summarize, chunk_size=16, params=params
+    )
+    whole = dict(target.summarize(flat))
+    for k, v in whole.items():
+        if k == "coverage_map":
+            continue  # merged as a union; compare directly below
+        if isinstance(v, (int, float)) and k != "seeds":
+            assert piped[k] == v, k
+    assert piped["coverage_map"] == whole["coverage_map"]
