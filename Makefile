@@ -28,6 +28,10 @@
 #                      mesh: device-count curve + a small sharded
 #                      campaign, summary/report bytes asserted
 #                      identical across mesh sizes
+#   make stream-smoke  persistent streaming sweep service
+#                      (docs/streaming.md): stream == chunked report
+#                      bytes, refill-schedule invariance, v9
+#                      interrupt/resume, zero-compile warmed stream
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -36,6 +40,7 @@
 #                      processes x two worker-pool sizes AND two mesh
 #                      sizes, byte-diffed)
 #                      + explore-smoke + oracle-smoke + multichip-smoke
+#                      + stream-smoke
 #   make dryrun        multi-chip gate: 8-device mesh, sharded==unsharded
 #                      and chunked==unsharded per-seed equality
 #   make bench-smoke   the whole bench pipeline on tiny shapes (~1 min)
@@ -50,7 +55,7 @@ PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
 	explore-smoke oracle-smoke differential-smoke wire-smoke \
-	multichip-smoke dryrun bench-smoke test-all
+	multichip-smoke stream-smoke dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -92,8 +97,14 @@ wire-smoke:
 multichip-smoke:
 	$(PY) scripts/multichip_campaign.py --smoke
 
+# the persistent streaming sweep service (docs/streaming.md): stream ==
+# chunked bytes, refill-schedule invariance, v9 interrupt/resume,
+# zero-compile warmed stream
+stream-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/stream_smoke.py
+
 stest: test determinism explore-smoke oracle-smoke differential-smoke \
-	wire-smoke multichip-smoke
+	wire-smoke multichip-smoke stream-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

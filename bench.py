@@ -89,6 +89,23 @@ CAMPAIGN_K = 16
 CAMPAIGN_SEEDS = 256
 CAMPAIGN_REPS = 2
 CAMPAIGN_SIM_SECONDS = 1.5
+# streaming leg (persistent lane pool vs fixed-shape chunks): etcd
+# under the gray-failure FaultSpec retires lanes at genuinely different
+# ages (measured max/mean step spread ~1.46 per chunk — crashes starve
+# some seeds of events while partition retries feed others), which is
+# the straggler pattern a fixed-shape chunk drags on; the pool is
+# HALF the chunk so the drain tail (the last pool-full of stragglers,
+# the only stretch a stream cannot refill) stays small relative to the
+# smallest curve point; round_steps can exceed the mean lane age
+# (~161) because the round exits early once a refill quorum retires,
+# so a large value just amortizes round dispatch
+STREAM_CURVE = (4096, 16384, 32768, 65536)
+STREAM_CHUNK = 1024
+STREAM_POOL = 512
+STREAM_ROUND_STEPS = 256
+STREAM_REPS = 2
+STREAM_SIM_SECONDS = 3.0
+STREAM_MAX_STEPS = 2_000
 
 _seed_cursor = [1]
 
@@ -477,6 +494,116 @@ def bench_campaign() -> dict:
     }
 
 
+def bench_streaming() -> dict:
+    """Streaming vs chunked seeds/s across the batch curve (ROADMAP
+    item 1, docs/streaming.md): the SAME etcd history sweep through
+    ``run_sweep_pipelined`` (fixed-shape chunks — each chunk drags to
+    its slowest lane) and ``engine.stream.stream_sweep`` (a
+    constant-occupancy lane pool continuously refilled from the work
+    queue), interleaved A/B reps per pallas_finding §0 (rep-outer,
+    driver-inner, fresh seed ranges, min-of-reps). The gray-failure
+    FaultSpec makes lanes retire at genuinely different ages (crashes
+    starve some seeds of events while partition retries feed others) —
+    exactly the straggler pattern fixed-shape chunking pays for. Every
+    rep asserts the two drivers' totals are identical (the byte
+    contract) and that the warmed stream region performs 0 XLA
+    compilations."""
+    from madsim_tpu.engine.checkpoint import run_sweep_pipelined
+    from madsim_tpu.engine.compiles import count_compiles
+    from madsim_tpu.engine.faults import FaultSpec
+    from madsim_tpu.engine.stream import stream_sweep
+    from madsim_tpu.models import etcd
+
+    cfg = etcd.EtcdConfig(
+        hist_slots=64,
+        bug_stale_read=True,
+        faults=FaultSpec(
+            crashes=2, partitions=2, spikes=1, losses=1, pauses=1
+        ),
+    )
+    ecfg = etcd.engine_config(
+        cfg, time_limit_ns=int(STREAM_SIM_SECONDS * 1e9),
+        max_steps=STREAM_MAX_STEPS,
+    )
+    wl = etcd.workload(cfg)
+    sizes = STREAM_CURVE
+    chunk = min(STREAM_CHUNK, min(sizes))
+    pool = min(STREAM_POOL, chunk)
+    kw = dict(chunk_size=chunk)
+
+    # warm both drivers' programs (the [chunk]/[pool]-shaped
+    # round/refill/summary programs serve every curve point) on a
+    # 2-chunk batch so the refill and merge paths are hot before any
+    # timed region
+    warm = _fresh(2 * chunk)
+    run_sweep_pipelined(wl, ecfg, warm, etcd.sweep_summary, **kw)
+    stream_sweep(
+        wl, ecfg, warm, etcd.sweep_summary, pool_size=pool,
+        round_steps=STREAM_ROUND_STEPS, **kw,
+    )
+
+    times_c = {s: [] for s in sizes}
+    times_s = {s: [] for s in sizes}
+    occs = {s: 0.0 for s in sizes}
+    stream_compiles = 0
+    for _rep in range(STREAM_REPS):
+        for s in sizes:
+            seeds = _fresh(s)  # same seeds for both drivers: the totals
+            #                    equality below is then a real byte check
+            t0 = walltime.perf_counter()
+            chunked = run_sweep_pipelined(
+                wl, ecfg, seeds, etcd.sweep_summary, **kw
+            )
+            times_c[s].append(walltime.perf_counter() - t0)
+            stats: dict = {}
+            with count_compiles() as c:
+                t0 = walltime.perf_counter()
+                streamed = stream_sweep(
+                    wl, ecfg, seeds, etcd.sweep_summary, pool_size=pool,
+                    round_steps=STREAM_ROUND_STEPS, stats=stats, **kw,
+                )
+                dt = walltime.perf_counter() - t0
+            stream_compiles += c.count
+            assert streamed == chunked, (
+                f"driver totals diverge at {s} seeds"
+            )
+            if not times_s[s] or dt < min(times_s[s]):
+                occs[s] = stats["occupancy_mean"]
+            times_s[s].append(dt)
+    assert stream_compiles == 0, (
+        f"{stream_compiles} XLA compilations in the warmed stream region"
+    )
+
+    curve = []
+    for s in sizes:
+        rate_c = s / min(times_c[s])
+        rate_s = s / min(times_s[s])
+        curve.append(
+            {
+                "seeds": s,
+                "chunked_seeds_per_sec": round(rate_c, 1),
+                "stream_seeds_per_sec": round(rate_s, 1),
+                "speedup": round(rate_s / rate_c, 2),
+                "occupancy_mean": round(occs[s], 3),
+                "totals_identical": True,
+                "spread_chunked": _spread(times_c[s]),
+                "spread_stream": _spread(times_s[s]),
+            }
+        )
+    return {
+        "workload": (
+            "etcd bug_stale_read + gray-failure FaultSpec "
+            "(straggler-heavy retirement, step spread ~1.46x)"
+        ),
+        "chunk_size": chunk,
+        "pool_size": pool,
+        "round_steps": STREAM_ROUND_STEPS,
+        "reps": STREAM_REPS,
+        "compiles_in_warmed_region": stream_compiles,
+        "curve": curve,
+    }
+
+
 def _leaf_np(a):
     """Host array for comparison; typed PRNG keys via their raw words."""
     if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
@@ -633,6 +760,7 @@ def main() -> None:
     kafka_line, etcd_line = bench_secondary_models()
     checked = bench_checked_sweep()
     campaign = bench_campaign()
+    streaming = bench_streaming()
 
     # HEADLINE = the chunked 131k sweep: the production pattern, and —
     # at ~3 s of device work per rep — the only number the tunneled
@@ -683,6 +811,7 @@ def main() -> None:
                 "sweep_100k": big,
                 "checked_sweep": checked,
                 "campaign": campaign,
+                "streaming": streaming,
                 "recovery_e2e": recovery,
                 "cross_backend": cross,
                 "kafka": kafka_line,
@@ -702,6 +831,8 @@ def _smoke() -> None:
     global PARITY_SEEDS, CHECKED_TOTAL, CHECKED_CHUNK, CHECKED_SIM_SECONDS
     global NAIVE_SEEDS, CHECK_WORKERS, PIPE_SEEDS, PIPE_CHUNK
     global CAMPAIGN_K, CAMPAIGN_SEEDS, CAMPAIGN_REPS, CAMPAIGN_SIM_SECONDS
+    global STREAM_CURVE, STREAM_CHUNK, STREAM_POOL, STREAM_REPS
+    global STREAM_SIM_SECONDS, STREAM_ROUND_STEPS, STREAM_MAX_STEPS
     # shrink the auto-picked curve point too: the default 128 MiB budget
     # would land it at 16k lanes — ~45 s of CPU sweeps in a smoke run
     os.environ.setdefault("MADSIM_CHUNK_BUDGET_BYTES", str(8 << 20))
@@ -723,6 +854,13 @@ def _smoke() -> None:
     CAMPAIGN_SEEDS = 32
     CAMPAIGN_REPS = 1
     CAMPAIGN_SIM_SECONDS = 0.5
+    STREAM_CURVE = (64, 128)
+    STREAM_CHUNK = 32
+    STREAM_POOL = 16
+    STREAM_ROUND_STEPS = 128
+    STREAM_REPS = 1
+    STREAM_SIM_SECONDS = 0.3
+    STREAM_MAX_STEPS = 2_000
 
 
 if __name__ == "__main__":
@@ -732,5 +870,9 @@ if __name__ == "__main__":
         # the campaign leg standalone (CPU is the compile-dominated
         # regime the ≥5x acceptance figure is measured in)
         print(json.dumps({"metric": "campaign_leg", **bench_campaign()}))
+    elif "--streaming" in sys.argv:
+        # the streaming leg standalone (the ≥1x-at-every-batch-size
+        # acceptance figure, incl. the 65,536 sag point)
+        print(json.dumps({"metric": "streaming_leg", **bench_streaming()}))
     else:
         main()

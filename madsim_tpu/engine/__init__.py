@@ -46,6 +46,7 @@ from .core import (  # noqa: E402
     step_batch,
 )
 from .queue import EventQueue  # noqa: E402
+from .stream import stream_sweep  # noqa: E402
 
 __all__ = [
     "EngineConfig",
@@ -57,4 +58,5 @@ __all__ = [
     "run_sweep",
     "run_traced",
     "step_batch",
+    "stream_sweep",
 ]

@@ -49,8 +49,17 @@ from .core import EngineConfig, EngineState, Workload
 #     chunk granules — their per-chunk files silently never matching —
 #     hence the bump; this reader still ACCEPTS v6/v7 files (the leaf
 #     layout is unchanged; an old snapshot simply has no mesh tag).
-_FORMAT_VERSION = 8
-_READABLE_VERSIONS = (6, 7, 8)
+# v9: streaming sweeps (engine/stream.py) — a snapshot may carry a
+#     heterogeneous IN-FLIGHT LANE POOL: ``__stream__`` bookkeeping
+#     (which work item each lane runs, per-lane step budgets, the queue
+#     cursor, merged totals so far) plus stacked ``pend_*`` arrays of
+#     captured-but-unflushed per-item results. v8 readers would load the
+#     pool as a plain whole-sweep snapshot and silently drop the pending
+#     results and queue position — hence the bump; this reader still
+#     ACCEPTS v6-v8 files (the leaf layout is unchanged; an old snapshot
+#     simply has no stream tag).
+_FORMAT_VERSION = 9
+_READABLE_VERSIONS = (6, 7, 8, 9)
 
 
 def save_sweep(
@@ -132,6 +141,92 @@ def load_sweep(path: str, like: EngineState) -> EngineState:
         else:
             out.append(jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype))
     return jax.tree.unflatten(treedef, out)
+
+
+def save_stream(
+    path: str,
+    state: EngineState,
+    *,
+    pending: dict,
+    susp: dict,
+    meta: dict,
+) -> None:
+    """Serialize a STREAMING sweep's full in-flight picture (checkpoint
+    format v9; ``engine/stream.stream_sweep`` is the only writer):
+
+    - the lane-pool ``EngineState`` (heterogeneous — each lane may run a
+      different work item, candidate and step budget), leaf-encoded like
+      ``save_sweep``;
+    - ``pending``: item index -> captured row leaves (raw host arrays,
+      key leaves as uint32 words — the stream's own row format) for
+      results retired but not yet flushed into a virtual chunk; stored
+      stacked per leaf (``pend_{j}``), item order in the meta;
+    - ``susp``: item index -> device-screen suspect bit (absent when the
+      stream runs unscreened);
+    - ``meta``: JSON-able stream bookkeeping (stream.py owns the keys:
+      lane->item map, budgets, queue cursor, flush cursor, merged totals,
+      identity guards)."""
+    import json
+
+    leaves, _ = jax.tree.flatten(state)
+    arrays = {}
+    for i, leaf in enumerate(leaves):
+        if jnp.issubdtype(leaf.dtype, jax.dtypes.prng_key):
+            arrays[f"leaf_{i}__key"] = np.asarray(jax.random.key_data(leaf))
+        else:
+            arrays[f"leaf_{i}"] = np.asarray(leaf)
+    items = sorted(int(i) for i in pending)
+    if items:
+        for j in range(len(leaves)):
+            arrays[f"pend_{j}"] = np.stack([pending[it][j] for it in items])
+    stream_meta = dict(meta)
+    stream_meta["items"] = items
+    stream_meta["susp"] = [
+        (None if it not in susp else bool(susp[it])) for it in items
+    ]
+    arrays["__stream__"] = np.frombuffer(
+        json.dumps(stream_meta, sort_keys=True).encode(), dtype=np.uint8
+    )
+    np.savez_compressed(path, __version__=_FORMAT_VERSION, **arrays)
+
+
+def load_stream(path: str, like: EngineState):
+    """Restore a v9 stream snapshot: ``(pool state, pending rows dict,
+    suspect-bit dict, stream meta)``. ``like`` supplies the pytree
+    structure and dtypes — an ``init_sweep`` result of the same pool
+    shape, or its ``jax.eval_shape`` (no device work needed)."""
+    import json
+
+    data = np.load(path)
+    found = int(data["__version__"])
+    if found not in _READABLE_VERSIONS or "__stream__" not in data:
+        raise ValueError(
+            f"{path} is not a readable stream snapshot (v{found}"
+            f"{', no __stream__ tag' if '__stream__' not in data else ''}); "
+            "stream snapshots are checkpoint format v9 "
+            "(engine/stream.stream_sweep ckpt_path=)"
+        )
+    leaves, treedef = jax.tree.flatten(like)
+    out = []
+    for i, leaf in enumerate(leaves):
+        if f"leaf_{i}__key" in data:
+            out.append(
+                jax.random.wrap_key_data(jnp.asarray(data[f"leaf_{i}__key"]))
+            )
+        else:
+            out.append(jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype))
+    state = jax.tree.unflatten(treedef, out)
+    meta = json.loads(bytes(bytearray(data["__stream__"])).decode())
+    pending = {}
+    susp = {}
+    for idx, it in enumerate(meta["items"]):
+        pending[int(it)] = [
+            data[f"pend_{j}"][idx] for j in range(len(leaves))
+        ]
+        bit = meta["susp"][idx]
+        if bit is not None:
+            susp[int(it)] = bool(bit)
+    return state, pending, susp, meta
 
 
 def resume_sweep(

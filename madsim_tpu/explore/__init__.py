@@ -44,7 +44,6 @@ from .campaign import (  # noqa: F401
     spec_to_dict,
     sweep_candidate_grid,
     target_envelope,
-    use_legacy_spec_path,
 )
 from .fleet import checked_sweep_curve, sharded_campaign  # noqa: F401
 from .differential import (  # noqa: F401

@@ -221,14 +221,6 @@ def spec_from_dict(d: dict):
     )
 
 
-def use_legacy_spec_path() -> bool:
-    """The pre-refactor compile-per-candidate path, kept behind an env
-    toggle for one round so ``scripts/check_determinism.sh`` can
-    byte-diff a spec-as-data campaign report against it
-    (``MADSIM_CAMPAIGN_LEGACY=1``)."""
-    return os.environ.get("MADSIM_CAMPAIGN_LEGACY", "") == "1"
-
-
 def target_envelope(target: Target, *specs, fixed: int = 0) -> FaultEnvelope:
     """The campaign envelope for ``target``: covers every given spec
     plus the mutator's ``_MAX_PHASES`` clamp, so every candidate any
@@ -360,17 +352,21 @@ def sweep_candidate_grid(
     return each candidate's summary dict — identical values to K calls
     of ``_sweep_candidate`` over the same pinned seed range.
 
-    This is the batched half of the spec-as-data tentpole: a campaign
-    round of small per-candidate sweeps (256 seeds each) under-occupies
-    the device, so K candidates stack their per-lane ``FaultParams``
-    into one flat ``K * seeds_per_round`` launch (vmapping the candidate
-    axis alongside the seed axis — to the engine they are just more
-    lanes). Per-candidate summaries fall out by slicing the flat final
-    state with ``core.lane_slice`` — one compiled slice program and one
-    compiled summary program serve every candidate, so a warmed grid
-    runs with ZERO XLA compilations regardless of K."""
-    from ..engine.core import lane_slice, run_in_chunks, run_sweep
+    This is the batched half of the spec-as-data tentpole, run through
+    the persistent streaming service (``engine.stream.stream_sweep``,
+    docs/streaming.md): the K * seeds_per_round (candidate x seed) work
+    items feed the lane pool's refill queue instead of chunk boundaries
+    — a candidate whose seeds all finish early releases its lanes to
+    the next candidate mid-flight, so the pool stays at constant
+    occupancy across the whole grid. The virtual chunk granule is ONE
+    candidate (``chunk_size=seeds_per_round``), so each flushed chunk
+    summary IS that candidate's summary — identical values to K calls
+    of ``_sweep_candidate``, and refill-schedule-invariant by the stream
+    contract. One compiled round/refill/summary program serves every
+    candidate: a warmed grid runs with ZERO XLA compilations regardless
+    of K."""
     from ..engine.faults import grid_params
+    from ..engine.stream import stream_sweep
 
     workload, ecfg = target.build(envelope)
     if workload.cover is None or workload.cover_bits == 0:
@@ -388,50 +384,50 @@ def sweep_candidate_grid(
         [spec_to_params(spec, envelope, target.num_nodes) for spec in specs],
         s,
     )
-    if mesh is not None:
-        from ..parallel.mesh import run_sweep_sharded
+    multiple = 1 if mesh is None else int(mesh.devices.size)
+    # the pool holds the same working set the chunked grid ran — the
+    # occupancy-knee granule, rounded up to mesh divisibility like every
+    # other sharded driver (stream_sweep caps it to the total)
+    pool = -(-max(ccfg.chunk_size, s) // multiple) * multiple
 
-        run_chunk = lambda chunk, pchunk: run_sweep_sharded(  # noqa: E731
-            workload, ecfg, chunk, mesh, params=pchunk
-        )
-        multiple = int(mesh.devices.size)
-    else:
-        run_chunk = lambda chunk, pchunk: run_sweep(  # noqa: E731
-            workload, ecfg, chunk, params=pchunk
-        )
-        multiple = 1
-    # chunk granule rounded up to mesh divisibility like every other
-    # sharded driver (run_in_chunks' multiple= pads only the
-    # single-chunk path)
-    chunk_size = -(-max(ccfg.chunk_size, s) // multiple) * multiple
-    final = run_in_chunks(
-        run_chunk, seeds, chunk_size, multiple=multiple, params=params,
-    )
+    # the serial pipeline's screen/host-work machinery, per candidate
+    # chunk: the device screen (run once per retirement cohort) clears
+    # the boring lanes and the WGL checker fans the suspects over the
+    # process pool — mirrors _sweep_candidate exactly, which is what
+    # keeps grid summaries byte-equal to serial rounds
+    screen_fn = None
+    if target.hist_spec is not None:
+        from ..oracle.screen import screen_for, screen_sweep
 
-    summaries: List[dict] = []
-    for i in range(k):
-        lane = lane_slice(final, s, i * s)
-        summary = dict(target.summarize(lane))
-        if target.hist_spec is not None:
-            # the serial pipeline's host-phase machinery, per candidate
-            # block: the device screen clears the boring lanes and the
-            # WGL checker fans the suspects over the process pool
+        if screen_for(target.hist_spec) is not None:
+            def screen_fn(final):
+                return screen_sweep(final, target.hist_spec, mesh=mesh)
+
+    def host_work(final, *, lo, n, seeds, suspect, summary) -> dict:
+        del lo, n, seeds
+        if suspect is not None:
             from ..oracle.check import violating_seeds
 
-            vio = np.asarray(
-                violating_seeds(
-                    lane, target.hist_spec, screen="auto",
-                    workers=ccfg.check_workers,
-                )
+            vio = violating_seeds(
+                final, target.hist_spec, screen=lambda _f: suspect,
+                workers=ccfg.check_workers,
             )
         else:
-            vio = np.asarray(target.violating(lane))
-        summary["violating_seeds"] = [
-            int(x) for x in vio[: ccfg.max_recorded_seeds]
-        ]
+            vio = np.asarray(target.violating(final))
+        out = {
+            "violating_seeds": [int(x) for x in vio[: ccfg.max_recorded_seeds]]
+        }
         if "violations" not in summary:
-            summary["violations"] = int(vio.size)
-        summaries.append(summary)
+            out["violations"] = int(vio.size)
+        return out
+
+    summaries: List[dict] = []
+    stream_sweep(
+        workload, ecfg, seeds, target.summarize,
+        params=params, chunk_size=s, pool_size=pool,
+        host_work=host_work, screen=screen_fn, mesh=mesh,
+        on_chunk=lambda *, lo, k, summary: summaries.append(summary),
+    )
     return summaries
 
 
@@ -465,14 +461,14 @@ def run_campaign(
     (docs/multichip.md). ``on_chunk(lo=, k=, summary=)`` fires per
     merged chunk (time-to-first-violation instrumentation).
 
-    Spec-as-data is the default sweep path: the campaign envelope
-    (``target_envelope``) is derived ONCE from the base spec + mutator
-    clamps, the workload compiles once for the envelope shape, and
-    every candidate rides in as per-lane ``FaultParams`` — a warmed
-    campaign runs its remaining rounds with zero XLA compilations
-    (``make explore-smoke`` counter-asserts this). Report bytes are
-    unchanged vs the pre-refactor compile-per-candidate path, which
-    survives one more round behind ``MADSIM_CAMPAIGN_LEGACY=1``.
+    Spec-as-data is the only sweep path (the pre-refactor
+    compile-per-candidate path and its ``MADSIM_CAMPAIGN_LEGACY``
+    toggle are gone): the campaign envelope (``target_envelope``) is
+    derived ONCE from the base spec + mutator clamps, the workload
+    compiles once for the envelope shape, and every candidate rides in
+    as per-lane ``FaultParams`` — a warmed campaign runs its remaining
+    rounds with zero XLA compilations (``make explore-smoke``
+    counter-asserts this).
     ``ccfg.batch > 1`` additionally sweeps that many candidates per
     device launch as one (candidate x seed) grid
     (``sweep_candidate_grid``); grid blocks skip per-round sweep
@@ -492,9 +488,7 @@ def run_campaign(
         "base_spec": spec_to_dict(base_spec),
     }
 
-    envelope = None if use_legacy_spec_path() else target_envelope(
-        target, base_spec
-    )
+    envelope = target_envelope(target, base_spec)
 
     def gen(r: int):
         """Candidate r: the base spec for round 0, a seeded mutation of
@@ -557,7 +551,7 @@ def run_campaign(
     stop = False
     r = 0
     while r < ccfg.rounds and not stop:
-        if ccfg.batch > 1 and envelope is not None:
+        if ccfg.batch > 1:
             block = [
                 gen(r + i) for i in range(min(ccfg.batch, ccfg.rounds - r))
             ]

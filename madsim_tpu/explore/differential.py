@@ -116,10 +116,10 @@ def device_outcomes(
 ) -> TierOutcome:
     """Sweep the device raft model over the grid and fold per-seed
     outcomes, checking every decoded election history against
-    ElectionSpec. One compiled sweep PER SPEC — the pre-refactor path,
-    kept for the ``MADSIM_CAMPAIGN_LEGACY=1`` byte-diff round; the gate
-    itself runs ``device_outcomes_grid`` (one compile for the whole
-    spec set)."""
+    ElectionSpec. One compiled sweep PER SPEC — the reference the grid
+    equality test pins (``TierOutcome``s bit-equal to
+    ``device_outcomes_grid``, which the gate itself runs: one compile
+    for the whole spec set)."""
     workload, ecfg = _device_raft_cfg(spec, dcfg)
     seeds = np.arange(dcfg.seed0, dcfg.seed0 + dcfg.seeds, dtype=np.int64)
     final = ecore.run_sweep_chunked(
@@ -282,15 +282,9 @@ def run_differential(
     the gate verdict: every spec's tolerance check held.
 
     The device half runs as ONE spec-as-data grid
-    (``device_outcomes_grid`` — one compile for the whole spec set);
-    ``MADSIM_CAMPAIGN_LEGACY=1`` keeps the compile-per-spec path for
-    one more round so the determinism gate can byte-diff the two."""
-    from .campaign import use_legacy_spec_path
-
-    if use_legacy_spec_path():
-        devs = [device_outcomes(spec, dcfg) for spec in specs]
-    else:
-        devs = device_outcomes_grid(specs, dcfg)
+    (``device_outcomes_grid`` — one compile for the whole spec set,
+    bit-equal per spec to ``device_outcomes``)."""
+    devs = device_outcomes_grid(specs, dcfg)
     records: List[dict] = []
     for spec, dev in zip(specs, devs):
         host = host_outcomes(spec, dcfg)

@@ -36,10 +36,8 @@ runtime ``FaultParams`` (bit-identical replay to a static ``FixedFaults``
 config; tests/test_fault_params.py). This replaced the
 compile-per-candidate cost model that used to dominate shrink
 wall-clock (one jit cache entry per distinct candidate config, seconds
-each on CPU); ``MADSIM_CAMPAIGN_LEGACY=1`` keeps that path for one
-round (``explore.campaign.use_legacy_spec_path``), and ``max_tests``
-still bounds the replay count because each replay costs a real traced
-run either way.
+each on CPU); ``max_tests`` still bounds the replay count because each
+replay costs a real traced run either way.
 """
 
 from __future__ import annotations
@@ -152,18 +150,13 @@ def shrink(
     # spec-as-data replay channel: one traced program per envelope WIDTH
     # (len(full) rounded up to a power of two — candidates are subsets,
     # and comparably-sized failures share the program), each candidate
-    # fed in as runtime FaultParams; the legacy toggle keeps the
-    # compile-per-candidate path for one byte-diff round
-    from .campaign import use_legacy_spec_path
+    # fed in as runtime FaultParams
+    from ..engine.faults import FaultEnvelope, spec_to_params
 
-    env = None
-    if not use_legacy_spec_path():
-        from ..engine.faults import FaultEnvelope, spec_to_params
-
-        width = 4
-        while width < len(full):
-            width *= 2
-        env = FaultEnvelope(fixed=width)
+    width = 4
+    while width < len(full):
+        width *= 2
+    env = FaultEnvelope(fixed=width)
 
     # memoize replays by event tuple: ddmin's regranulation can revisit a
     # subset, and the final verification is always the last accepted
@@ -176,15 +169,10 @@ def shrink(
         key = tuple(events)
         if key not in replayed:
             fixed = to_fixed(spec, events)
-            if env is None:
-                replayed[key] = triage_seed(
-                    target, fixed, seed, history=history
-                )
-            else:
-                replayed[key] = triage_seed(
-                    target, env, seed, history=history,
-                    params=spec_to_params(fixed, env, target.num_nodes),
-                )
+            replayed[key] = triage_seed(
+                target, env, seed, history=history,
+                params=spec_to_params(fixed, env, target.num_nodes),
+            )
         return replayed[key]
 
     def reproduces(events: List[FaultEvent]) -> bool:

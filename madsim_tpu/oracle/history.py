@@ -207,6 +207,40 @@ def history_bytes(hist: History) -> bytes:
     return ("\n".join(lines) + "\n").encode()
 
 
+def history_canonical_bytes(hist: History) -> bytes:
+    """Seed-free, time-rank canonical encoding — the dedup key for WGL
+    checking (oracle/screen.history_host_work).
+
+    Two histories that differ only in seed and in the absolute values of
+    their timestamps (but agree on every op field and on the relative
+    order of all invoke/complete times) get identical bytes. The WGL
+    search and every structural pre-pass read timestamps only through
+    comparisons, so replacing each distinct time by its dense rank is an
+    order-isomorphism that preserves the checker's verdict exactly —
+    one representative verdict is valid for the whole equivalence class.
+    Open ops keep their ``-1`` completion sentinel. Unlike
+    ``history_bytes`` this is NOT the determinism-gate encoding: it
+    deliberately erases the seed and the absolute clock."""
+    ts = sorted(
+        {
+            t
+            for o in hist.ops
+            for t in (o.invoke_ns, o.complete_ns)
+            if t >= 0
+        }
+    )
+    rank = {t: i for i, t in enumerate(ts)}
+    lines = [f"rows={hist.rows} overflow={int(hist.overflow)}"]
+    lines += [
+        f"c={o.client} op={OP_NAMES[o.op]} key={o.key} in={o.inp} "
+        f"out={o.out if o.complete else '?'} "
+        f"t=[{rank[o.invoke_ns]},{rank[o.complete_ns] if o.complete else -1}]"
+        f" id={o.opid}"
+        for o in hist.ops
+    ]
+    return ("\n".join(lines) + "\n").encode()
+
+
 class HostRecorder:
     """Thin client-shim recording host-tier operation histories.
 

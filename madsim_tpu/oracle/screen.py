@@ -358,12 +358,23 @@ def history_host_work(
     fan the WGL checker over a process pool, and fold the verdicts into
     the chunk summary.
 
+    Suspect lanes are deduplicated before checking: identical histories
+    across seeds are common under coarse faults, and the WGL verdict
+    depends only on the seed-free, time-rank canonical encoding
+    (``history.history_canonical_bytes`` — an order-isomorphism on the
+    timestamps the checker reads only through comparisons). One
+    representative per equivalence class (first occurrence, lane order)
+    is checked; its verdict fans back to every member, and the report
+    carries the class count as ``hist_unique``.
+
     Determinism contract: the returned dict is a pure function of the
     chunk's history planes — worker count changes wall-clock only, never
-    a byte of the report (results are ordered by lane, and each
-    verdict is a pure function of one history)."""
+    a byte of the report (results are ordered by lane, dedup keys on
+    content, and each verdict is a pure function of one history)."""
+    import hashlib
+
     from .check import check_histories
-    from .history import decode_lanes
+    from .history import decode_lanes, history_canonical_bytes
 
     def host_work(final, *, lo, n, seeds, suspect, summary):
         del lo, seeds, summary
@@ -372,14 +383,26 @@ def history_host_work(
         else:
             lanes = np.nonzero(np.asarray(suspect)[:n])[0]
         hists = decode_lanes(final, lanes)
-        results = check_histories(
-            hists, spec, max_states=max_states, workers=workers
+        keys = [
+            hashlib.sha256(history_canonical_bytes(h)).digest()
+            for h in hists
+        ]
+        rep: dict = {}  # canonical hash -> index into reps
+        reps = []
+        for h, k in zip(hists, keys):
+            if k not in rep:
+                rep[k] = len(reps)
+                reps.append(h)
+        rep_results = check_histories(
+            reps, spec, max_states=max_states, workers=workers
         )
+        results = [rep_results[rep[k]] for k in keys]
         bad = [int(h.seed) for h, r in zip(hists, results) if not r.ok]
         undecided = sum(1 for r in results if not r.decided)
         return {
             "hist_screened": int(n),
             "hist_suspects": int(lanes.size),
+            "hist_unique": len(reps),
             "hist_violations": len(bad),
             "hist_undecided": int(undecided),
             "hist_violating_seeds": bad[:max_recorded],
@@ -405,6 +428,7 @@ def checked_sweep(
     chunk_per_device: Optional[int] = None,
     max_recorded: int = 32,
     on_chunk=None,
+    driver: str = "chunked",
 ) -> dict:
     """End-to-end checked sweep: pipelined chunked sweep + on-device
     screening + process-pool WGL checking, merged into one summary dict.
@@ -428,9 +452,20 @@ def checked_sweep(
     ``hist_violating_seeds`` sample composes chunking-invariantly —
     each chunk records at most ``max_recorded`` violators (lane order)
     and the merged list is capped to the same bound, so a prefix kept
-    per chunk can never change the global first-``max_recorded`` set."""
+    per chunk can never change the global first-``max_recorded`` set.
+
+    ``driver="stream"`` routes the sweep through the persistent lane
+    pool (``engine.stream.stream_sweep``, docs/streaming.md): the screen
+    runs once per retirement cohort on the whole pool, and the flushed
+    reports are byte-identical to this function's chunked output —
+    same virtual chunk boundaries, same merge order. The stream driver
+    keeps its own checkpoint semantics (``stream_sweep(ckpt_path=...)``),
+    so the chunk-granule ``ckpt_dir``/``stop_after``/``resume_from``
+    arguments are rejected here."""
     from ..engine.checkpoint import run_sweep_pipelined
 
+    if driver not in ("chunked", "stream"):
+        raise ValueError(f"unknown driver {driver!r}")
     screen_fn = None
     if screen:
         if screen_for(spec) is None:
@@ -443,7 +478,36 @@ def checked_sweep(
         spec, max_states=max_states, workers=workers,
         max_recorded=max_recorded,
     )
-    if mesh is not None:
+    if driver == "stream":
+        from ..engine.core import pick_chunk_size
+        from ..engine.stream import stream_sweep
+
+        if ckpt_dir is not None or stop_after is not None or resume_from:
+            raise ValueError(
+                "driver='stream' manages its own snapshots — use "
+                "engine.stream.stream_sweep(ckpt_path=...) directly for "
+                "interrupt/resume"
+            )
+        if chunk_size is None:
+            if mesh is not None:
+                n_dev = int(mesh.devices.size)
+                cpd = (
+                    pick_chunk_size(workload, cfg)
+                    if chunk_per_device is None
+                    else chunk_per_device
+                )
+                chunk_size = cpd * n_dev
+            else:
+                chunk_size = pick_chunk_size(workload, cfg)
+        if mesh is not None:
+            n_dev = int(mesh.devices.size)
+            chunk_size = -(-chunk_size // n_dev) * n_dev
+        totals = stream_sweep(
+            workload, cfg, seeds, summarize,
+            chunk_size=chunk_size, host_work=host_work,
+            screen=screen_fn, mesh=mesh, on_chunk=on_chunk,
+        )
+    elif mesh is not None:
         from ..parallel.mesh import run_sweep_sharded_pipelined
 
         totals = run_sweep_sharded_pipelined(

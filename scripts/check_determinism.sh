@@ -82,24 +82,19 @@ dump "$out/b.npz"
 if cmp -s "$out/a.npz" "$out/b.npz"; then
   echo "determinism gate: OK (two processes, byte-identical traces + histories)"
 
-  # explore leg: two campaign runs of one campaign seed must emit
-  # byte-identical JSONL reports (no shrink — this leg checks the
-  # campaign loop + coverage accounting, cheaply). The second run pins
-  # the SPEC-AS-DATA contract too: it runs the pre-refactor
-  # compile-per-candidate path (MADSIM_CAMPAIGN_LEGACY=1, kept for one
-  # round — docs/faults.md "Spec-as-data"), so the byte-compare asserts
-  # the envelope/FaultParams path reproduces the legacy report exactly.
-  # The demo exits nonzero when its tiny budget finds no violation —
-  # expected here; only a MISSING report means the campaign crashed.
-  JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
-    --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
-    --report "$out/a.jsonl" >"$out/a.log" 2>&1 || true
-  JAX_PLATFORMS=cpu MADSIM_CAMPAIGN_LEGACY=1 "${PY:-python}" \
-    scripts/explore_demo.py \
-    --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
-    --report "$out/b.jsonl" >"$out/b.log" 2>&1 || true
+  # explore leg: two campaign runs of one campaign seed, in two
+  # separate processes, must emit byte-identical JSONL reports (no
+  # shrink — this leg checks the campaign loop + coverage accounting,
+  # cheaply). The demo exits nonzero when its tiny budget finds no
+  # violation — expected here; only a MISSING report means the campaign
+  # crashed.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
+      --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
+      --report "$out/$r.jsonl" >"$out/$r.log" 2>&1 || true
+  done
   if [ -s "$out/a.jsonl" ] && cmp -s "$out/a.jsonl" "$out/b.jsonl"; then
-    echo "determinism gate: OK (campaign spec-as-data == legacy path, byte-identical reports)"
+    echo "determinism gate: OK (two campaign runs, byte-identical reports)"
   else
     echo "determinism gate: FAILED — campaign reports differ or are empty" >&2
     diff "$out/a.jsonl" "$out/b.jsonl" >&2 || true
@@ -162,6 +157,29 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     exit 1
   fi
 
+  # streaming leg (docs/streaming.md): the SAME checked-sweep report
+  # must be byte-identical across two processes x two DRIVERS — the
+  # persistent lane pool retires and refills lanes on a schedule the
+  # chunked driver never sees, but per-seed results are bit-identical
+  # and the virtual-chunk flush reproduces the chunked report byte for
+  # byte. Compared against the unsharded w0 reference above, so the
+  # stream driver joins the one pinned byte string.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/checked_sweep_demo.py \
+      --seeds 96 --chunk-size 32 --workers 0 --driver stream \
+      --report "$out/cs_${r}_stream.json" >"$out/cs_${r}_stream.log" 2>&1
+  done
+  if [ -s "$out/cs_a_stream.json" ] \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_stream.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_stream.json"; then
+    echo "determinism gate: OK (streaming checked sweep, 2 processes x 2 drivers, byte-identical)"
+  else
+    echo "determinism gate: FAILED — streaming checked-sweep reports differ from chunked or are empty" >&2
+    for f in "$out"/cs_*stream*.json; do echo "--- $f"; cat "$f"; done >&2 || true
+    cat "$out"/cs_*stream*.log >&2 || true
+    exit 1
+  fi
+
   # wire leg (docs/wire.md): the Kafka-binary-wire load report and the
   # wire differential-fuzz report must each be byte-identical across two
   # processes; each load run ALSO asserts the second path in-process —
@@ -193,15 +211,11 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
   # processes — a small matched grid here; the full 200-seed tolerance
   # gate runs as `make differential-smoke`. Tolerance verdicts on this
   # tiny grid are not the point (|| true); only the report bytes are.
-  # The db run takes the legacy compile-per-spec device path, so the
-  # compare also pins spec-as-data grid == legacy, byte for byte.
-  JAX_PLATFORMS=cpu "${PY:-python}" scripts/differential_demo.py \
-    --seeds 32 --sim-seconds 1.5 --specs 2 \
-    --report "$out/da.json" >"$out/da.log" 2>&1 || true
-  JAX_PLATFORMS=cpu MADSIM_CAMPAIGN_LEGACY=1 "${PY:-python}" \
-    scripts/differential_demo.py \
-    --seeds 32 --sim-seconds 1.5 --specs 2 \
-    --report "$out/db.json" >"$out/db.log" 2>&1 || true
+  for r in da db; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/differential_demo.py \
+      --seeds 32 --sim-seconds 1.5 --specs 2 \
+      --report "$out/$r.json" >"$out/$r.log" 2>&1 || true
+  done
   if [ -s "$out/da.json" ] && cmp -s "$out/da.json" "$out/db.json"; then
     echo "determinism gate: OK (two differential runs, byte-identical reports)"
   else

@@ -16,6 +16,13 @@ stderr, where the gate ignores it.
 
 Usage: python scripts/checked_sweep_demo.py [--seeds N] [--chunk-size C]
            [--workers W] [--clean] [--report PATH] [--mesh N]
+           [--driver chunked|stream]
+
+``--driver stream`` routes the identical pipeline through the
+persistent streaming lane pool (``engine.stream.stream_sweep``,
+docs/streaming.md); the report must be byte-identical to the chunked
+driver's — the gate's streaming leg runs 2 processes x 2 drivers and
+diffs all four.
 
 ``--mesh N`` runs the identical pipeline sharded over an N-device mesh
 (re-execing under the forced CPU host mesh when needed) — the report
@@ -50,6 +57,11 @@ def main() -> int:
     ap.add_argument("--report", default=None)
     ap.add_argument("--mesh", type=int, default=0,
                     help="shard the pipeline over an N-device mesh")
+    ap.add_argument(
+        "--driver", choices=("chunked", "stream"), default="chunked",
+        help="sweep driver; the report bytes must not depend on this "
+        "(the streaming leg of check_determinism.sh diffs the two)",
+    )
     args = ap.parse_args()
 
     mesh = None
@@ -79,6 +91,7 @@ def main() -> int:
     totals = checked_sweep(
         wl, ecfg, seeds, etcd.history_spec(), etcd.sweep_summary,
         chunk_size=args.chunk_size, workers=args.workers, mesh=mesh,
+        driver=args.driver,
     )
     wall = time.perf_counter() - t0
 

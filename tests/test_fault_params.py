@@ -9,7 +9,6 @@ artifacts — as the static compile-per-spec path, while a warmed campaign
 of mutated candidates performs ZERO XLA compilations.
 """
 
-import os
 import random
 
 import jax
@@ -230,24 +229,20 @@ def _campaign_fixture():
     return target, base
 
 
-def test_campaign_report_bytes_identical_to_legacy(tmp_path):
-    # the hard byte-identity constraint: spec-as-data (default) vs the
-    # pre-refactor compile-per-candidate path (MADSIM_CAMPAIGN_LEGACY=1)
-    # for the same campaign seed
+def test_campaign_report_bytes_reproducible(tmp_path):
+    # the hard byte-identity constraint: two runs of one campaign seed
+    # write identical JSONL (the legacy compile-per-candidate A/B leg is
+    # gone — spec-as-data is the only path)
     target, base = _campaign_fixture()
     ccfg = explore.CampaignConfig(
         rounds=3, seeds_per_round=32, campaign_seed=11
     )
-    p_data = tmp_path / "data.jsonl"
-    p_legacy = tmp_path / "legacy.jsonl"
-    explore.run_campaign(target, base, ccfg, report_path=str(p_data))
-    os.environ["MADSIM_CAMPAIGN_LEGACY"] = "1"
-    try:
-        assert explore.use_legacy_spec_path()
-        explore.run_campaign(target, base, ccfg, report_path=str(p_legacy))
-    finally:
-        del os.environ["MADSIM_CAMPAIGN_LEGACY"]
-    assert p_data.read_bytes() == p_legacy.read_bytes()
+    p_a = tmp_path / "a.jsonl"
+    p_b = tmp_path / "b.jsonl"
+    explore.run_campaign(target, base, ccfg, report_path=str(p_a))
+    explore.run_campaign(target, base, ccfg, report_path=str(p_b))
+    assert p_a.read_bytes() == p_b.read_bytes()
+    assert not hasattr(explore, "use_legacy_spec_path")
 
 
 def test_warmed_campaign_zero_compiles():
@@ -312,7 +307,7 @@ def test_batched_campaign_runs_and_is_deterministic(tmp_path):
     assert ra.records[0]["retained"]
 
 
-def test_differential_grid_matches_legacy_outcomes():
+def test_differential_grid_matches_per_spec_outcomes():
     dcfg = explore.DifferentialConfig(seeds=16, sim_seconds=1.0)
     specs = explore.gate_specs()
     grid = explore.device_outcomes_grid(specs, dcfg)
@@ -320,9 +315,9 @@ def test_differential_grid_matches_legacy_outcomes():
         assert got == explore.device_outcomes(spec, dcfg)
 
 
-def test_shrink_identical_through_envelope():
-    # ddmin re-verification through the fixed-width envelope returns the
-    # same minimal artifact as the compile-per-candidate path
+def test_shrink_deterministic_through_envelope():
+    # ddmin re-verification through the fixed-width envelope is a pure
+    # function of (spec, seed): two runs return the same minimal artifact
     target, base = _campaign_fixture()
     ccfg = explore.CampaignConfig(
         rounds=8, seeds_per_round=64, campaign_seed=1, stop_after_failures=1
@@ -332,11 +327,7 @@ def test_shrink_identical_through_envelope():
         pytest.skip("tiny campaign budget found no failure on this config")
     spec, seed = result.failures[0]
     got = explore.shrink(target, spec, seed, max_tests=24)
-    os.environ["MADSIM_CAMPAIGN_LEGACY"] = "1"
-    try:
-        want = explore.shrink(target, spec, seed, max_tests=24)
-    finally:
-        del os.environ["MADSIM_CAMPAIGN_LEGACY"]
+    want = explore.shrink(target, spec, seed, max_tests=24)
     assert (got is None) == (want is None)
     if got is not None:
         assert got.schedule == want.schedule

@@ -350,6 +350,7 @@ def _serial_checked(wl, ecfg, seeds, spec, chunk_size):
     """Reference totals: per-chunk sweep + summary + decode-everything
     checking, merged in chunk order — what the pipeline must equal."""
     from madsim_tpu.oracle import check_histories, decode_sweep
+    from madsim_tpu.oracle.history import history_canonical_bytes
 
     totals = {}
     seeds = np.asarray(seeds)
@@ -372,6 +373,9 @@ def _serial_checked(wl, ecfg, seeds, spec, chunk_size):
             {
                 "hist_screened": len(hists),
                 "hist_suspects": len(hists),
+                "hist_unique": len(
+                    {history_canonical_bytes(h) for h in hists}
+                ),
                 "hist_violations": len(bad),
                 "hist_undecided": 0,
                 "hist_violating_seeds": bad[:32],
@@ -407,11 +411,18 @@ def test_pipelined_checked_sweep_matches_serial_and_pool_sizes(
         screen=False,
     )
     assert pooled == piped
-    drop = lambda d: {k: v for k, v in d.items() if k != "hist_suspects"}
+    # suspect/unique counts depend on the screen setting (they count
+    # checked lanes, and the naive path checks every lane); everything
+    # verdict-bearing must agree
+    drop = lambda d: {
+        k: v for k, v in d.items()
+        if k not in ("hist_suspects", "hist_unique")
+    }
     assert drop(naive) == drop(piped)
     assert serial == naive
     assert piped["hist_violations"] >= 1
     assert piped["hist_suspects"] <= piped["hist_screened"]
+    assert piped["hist_unique"] <= piped["hist_suspects"]
 
 
 def test_campaign_screened_history_target():

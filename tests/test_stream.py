@@ -1,0 +1,153 @@
+"""The persistent streaming sweep service (engine/stream.py).
+
+The contracts under test (docs/streaming.md): per-seed summaries
+bit-identical to the chunked pipelined driver on every bundled model,
+report bytes invariant to the refill schedule, interrupt/resume through
+a v9 stream snapshot bit-identical to the uninterrupted run, and a
+warmed multi-candidate stream (spec-as-data lanes of different
+FaultParams in one pool) performing ZERO XLA compilations. Plus the
+canonical-history dedup key the streaming checked sweep's WGL stage
+relies on (oracle/history.history_canonical_bytes).
+"""
+
+import os
+import tempfile
+
+import jax.numpy as jnp
+import numpy as np
+
+from madsim_tpu.engine import core as ecore
+from madsim_tpu.engine.checkpoint import run_sweep_pipelined
+from madsim_tpu.engine.compiles import count_compiles
+from madsim_tpu.engine.stream import stream_sweep
+from madsim_tpu.models import etcd, kafka, raft
+
+_SEEDS = 24
+_KW = dict(time_limit_ns=500_000_000, max_steps=4_000)
+
+
+def _etcd():
+    cfg = etcd.EtcdConfig(hist_slots=64, bug_stale_read=True)
+    return etcd.workload(cfg), etcd.engine_config(cfg, **_KW), etcd.sweep_summary
+
+
+def _cases():
+    rcfg = raft.RaftConfig(num_nodes=3)
+    kcfg = kafka.KafkaConfig()
+    return (
+        (raft.workload(rcfg), raft.engine_config(rcfg, **_KW),
+         raft.sweep_summary),
+        _etcd(),
+        (kafka.workload(kcfg), kafka.engine_config(kcfg, **_KW),
+         kafka.sweep_summary),
+    )
+
+
+def test_stream_matches_chunked_raft_etcd_kafka():
+    seeds = jnp.arange(_SEEDS, dtype=jnp.int64)
+    for wl, ecfg, summarize in _cases():
+        chunked = run_sweep_pipelined(wl, ecfg, seeds, summarize, chunk_size=8)
+        streamed = stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8,
+            round_steps=128,
+        )
+        assert streamed == chunked
+
+
+def test_refill_schedule_invariance():
+    wl, ecfg, summarize = _etcd()
+    seeds = jnp.arange(_SEEDS, dtype=jnp.int64)
+    base = stream_sweep(
+        wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8, round_steps=128
+    )
+    for perm_seed in (0, 3):
+        order = np.random.default_rng(perm_seed).permutation(_SEEDS)
+        assert (
+            stream_sweep(
+                wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8,
+                round_steps=128, queue_order=order,
+            )
+            == base
+        )
+
+
+def test_interrupt_resume_v9_bit_identity():
+    wl, ecfg, summarize = _etcd()
+    seeds = jnp.arange(_SEEDS, dtype=jnp.int64)
+    full = stream_sweep(
+        wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8, round_steps=64
+    )
+    with tempfile.TemporaryDirectory() as d:
+        path = os.path.join(d, "stream.npz")
+        stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8,
+            round_steps=64, ckpt_path=path, stop_after_rounds=1,
+        )
+        assert os.path.exists(path)
+        resumed = stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8,
+            round_steps=64, resume_from=path,
+        )
+    assert resumed == full
+
+
+def test_warmed_multicandidate_stream_zero_compiles():
+    # lanes of DIFFERENT candidates share one pool: K specs x s seeds
+    # feed the refill queue, and a warmed stream over fresh candidates
+    # compiles nothing — the spec-as-data streaming contract
+    from madsim_tpu.engine import faults as efaults
+
+    base = efaults.FaultSpec(
+        crashes=1, crash_window_ns=400_000_000,
+        restart_lo_ns=50_000_000, restart_hi_ns=100_000_000,
+    )
+    env = efaults.campaign_envelope(base, mutation_cap=2)
+    cfg = raft.RaftConfig(num_nodes=3, faults=env)
+    wl, ecfg = raft.workload(cfg), raft.engine_config(cfg, **_KW)
+    s = 8
+
+    def grid(specs):
+        seeds = np.tile(np.arange(s, dtype=np.int64), len(specs))
+        params = efaults.grid_params(
+            [efaults.spec_to_params(sp, env, cfg.num_nodes) for sp in specs],
+            s,
+        )
+        return stream_sweep(
+            wl, ecfg, seeds, raft.sweep_summary, params=params,
+            chunk_size=s, pool_size=2 * s, round_steps=128,
+        )
+
+    cands = [base, base._replace(crashes=2), base._replace(partitions=1)]
+    grid(cands[:3])  # warm
+    with count_compiles() as c:
+        got = grid([cands[1], cands[2], base._replace(crashes=0)])
+    assert c.count == 0, f"{c.count} XLA compilations in a warmed stream"
+    assert got["events_total"] > 0
+
+
+def test_canonical_bytes_dedup_key():
+    # the WGL dedup key: seed-free and invariant to absolute timestamps
+    # (dense time-rank), but sensitive to everything the checker reads
+    from madsim_tpu.oracle.history import (
+        History,
+        Op,
+        history_bytes,
+        history_canonical_bytes,
+    )
+
+    def hist(seed, t0):
+        ops = (
+            Op(client=0, op=0, key=1, inp=7, out=7,
+               invoke_ns=t0, complete_ns=t0 + 10, opid=0),
+            Op(client=1, op=1, key=1, inp=0, out=7,
+               invoke_ns=t0 + 5, complete_ns=-1, opid=0),
+        )
+        return History(seed=seed, ops=ops, overflow=False, rows=4)
+
+    a, b = hist(3, 1_000), hist(9, 50_000)
+    assert history_bytes(a) != history_bytes(b)
+    assert history_canonical_bytes(a) == history_canonical_bytes(b)
+    # a changed verdict-relevant field must change the key
+    c = hist(3, 1_000)
+    c = c._replace(ops=(c.ops[0]._replace(out=8),) + c.ops[1:])
+    assert history_canonical_bytes(c) != history_canonical_bytes(a)
