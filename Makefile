@@ -32,6 +32,11 @@
 #                      (docs/streaming.md): stream == chunked report
 #                      bytes, refill-schedule invariance, v9
 #                      interrupt/resume, zero-compile warmed stream
+#   make obs-smoke     fleet telemetry (docs/observability.md): reports
+#                      byte-equal with telemetry on/off, Perfetto trace
+#                      with visible device/host overlap + stream refill
+#                      cadence, run journal, live /metrics endpoint,
+#                      device-side event-mix plane
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -55,7 +60,7 @@ PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
 	explore-smoke oracle-smoke differential-smoke wire-smoke \
-	multichip-smoke stream-smoke dryrun bench-smoke test-all
+	multichip-smoke stream-smoke obs-smoke dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -103,8 +108,13 @@ multichip-smoke:
 stream-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/stream_smoke.py
 
+# the fleet telemetry subsystem (docs/observability.md): out-of-band
+# reports, Perfetto trace artifact, journal, exposition, event mix
+obs-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
+
 stest: test determinism explore-smoke oracle-smoke differential-smoke \
-	wire-smoke multichip-smoke stream-smoke
+	wire-smoke multichip-smoke stream-smoke obs-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

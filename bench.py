@@ -106,6 +106,18 @@ STREAM_ROUND_STEPS = 256
 STREAM_REPS = 2
 STREAM_SIM_SECONDS = 3.0
 STREAM_MAX_STEPS = 2_000
+# telemetry leg (obs overhead on the streaming checked-sweep path):
+# the SAME stream_sweep-driven checked sweep with telemetry off
+# (telemetry=None — the true zero-instrumentation baseline) vs on
+# (full-fat handle: metrics + journal + trace spans), interleaved
+# on/off reps per pallas_finding §0; the gate is ≤3% overhead, and the
+# two legs' report dicts must be equal (the out-of-band contract,
+# checked here on every bench run, byte-level in check_determinism.sh)
+TELEM_SEEDS = 16384
+TELEM_CHUNK = 1024
+TELEM_REPS = 3
+TELEM_SIM_SECONDS = 2.0
+TELEM_OVERHEAD_GATE = 0.03
 
 _seed_cursor = [1]
 
@@ -604,6 +616,89 @@ def bench_streaming() -> dict:
     }
 
 
+def bench_telemetry() -> dict:
+    """Telemetry overhead on the streaming checked-sweep path.
+
+    Per rep (interleaved on/off, docs/pallas_finding.md §0): leg OFF
+    runs ``checked_sweep(driver="stream")`` with ``telemetry=None`` —
+    every recorder is behind an ``if telemetry is not None`` guard, so
+    this is the genuine uninstrumented baseline; leg ON runs the same
+    seeds with a full-fat ``obs.Telemetry`` (metrics registry + JSONL
+    journal + trace spans — the most expensive configuration a user can
+    enable). Every rep asserts the two report dicts are EQUAL (the
+    out-of-band contract; the determinism gate byte-diffs the same
+    thing across processes). The figure is min-of-reps wall per leg;
+    ``overhead`` is on/off − 1, gated ≤ TELEM_OVERHEAD_GATE."""
+    import tempfile as _tmp
+
+    from madsim_tpu.engine.faults import FaultSpec
+    from madsim_tpu.models import etcd
+    from madsim_tpu.obs import Telemetry
+    from madsim_tpu.oracle.screen import checked_sweep
+
+    cfg = etcd.EtcdConfig(
+        hist_slots=64,
+        faults=FaultSpec(crashes=2, partitions=2, spikes=1),
+    )
+    ecfg = etcd.engine_config(
+        cfg, time_limit_ns=int(TELEM_SIM_SECONDS * 1e9),
+        max_steps=STREAM_MAX_STEPS,
+    )
+    wl = etcd.workload(cfg)
+    spec = etcd.history_spec()
+    kw = dict(
+        chunk_size=TELEM_CHUNK, workers=0, driver="stream",
+    )
+
+    # warm both legs' programs (identical programs — telemetry never
+    # changes a traced computation, only wall-clock-side bookkeeping)
+    checked_sweep(wl, ecfg, _fresh(TELEM_CHUNK), spec,
+                  etcd.sweep_summary, **kw)
+
+    times_off, times_on = [], []
+    with _tmp.TemporaryDirectory() as d:
+        for rep in range(TELEM_REPS):
+            seeds = _fresh(TELEM_SEEDS)  # same seeds both legs: the
+            #                              equality below is a real check
+            t0 = walltime.perf_counter()
+            off = checked_sweep(wl, ecfg, seeds, spec,
+                                etcd.sweep_summary, **kw)
+            times_off.append(walltime.perf_counter() - t0)
+            telem = Telemetry(
+                journal=os.path.join(d, f"rep{rep}.jsonl"),
+                trace=os.path.join(d, f"rep{rep}.trace.json"),
+            )
+            t0 = walltime.perf_counter()
+            on = checked_sweep(wl, ecfg, seeds, spec,
+                               etcd.sweep_summary, telemetry=telem, **kw)
+            times_on.append(walltime.perf_counter() - t0)
+            telem.close()
+            assert on == off, "telemetry changed the report — OUT-OF-BAND BROKEN"
+        snapshot = telem.registry.snapshot()
+    overhead = min(times_on) / min(times_off) - 1
+    return {
+        "seeds": TELEM_SEEDS,
+        "chunk_size": TELEM_CHUNK,
+        "reps": TELEM_REPS,
+        "off_seeds_per_sec": round(TELEM_SEEDS / min(times_off), 1),
+        "on_seeds_per_sec": round(TELEM_SEEDS / min(times_on), 1),
+        "overhead": round(overhead, 4),
+        "overhead_ok": overhead <= TELEM_OVERHEAD_GATE,
+        "gate": TELEM_OVERHEAD_GATE,
+        "reports_identical": True,
+        "spread_off": _spread(times_off),
+        "spread_on": _spread(times_on),
+        # a few sanity series from the last ON rep, proving the
+        # instrumentation actually fired while the reports stayed equal
+        "sample_metrics": {
+            k: snapshot.get(k)
+            for k in ("stream_rounds_total", "stream_seeds_done_total",
+                      "oracle_screened_total")
+            if k in snapshot
+        },
+    }
+
+
 def _leaf_np(a):
     """Host array for comparison; typed PRNG keys via their raw words."""
     if jnp.issubdtype(a.dtype, jax.dtypes.prng_key):
@@ -761,6 +856,7 @@ def main() -> None:
     checked = bench_checked_sweep()
     campaign = bench_campaign()
     streaming = bench_streaming()
+    telemetry = bench_telemetry()
 
     # HEADLINE = the chunked 131k sweep: the production pattern, and —
     # at ~3 s of device work per rep — the only number the tunneled
@@ -812,6 +908,7 @@ def main() -> None:
                 "checked_sweep": checked,
                 "campaign": campaign,
                 "streaming": streaming,
+                "telemetry": telemetry,
                 "recovery_e2e": recovery,
                 "cross_backend": cross,
                 "kafka": kafka_line,
@@ -833,6 +930,7 @@ def _smoke() -> None:
     global CAMPAIGN_K, CAMPAIGN_SEEDS, CAMPAIGN_REPS, CAMPAIGN_SIM_SECONDS
     global STREAM_CURVE, STREAM_CHUNK, STREAM_POOL, STREAM_REPS
     global STREAM_SIM_SECONDS, STREAM_ROUND_STEPS, STREAM_MAX_STEPS
+    global TELEM_SEEDS, TELEM_CHUNK, TELEM_REPS, TELEM_SIM_SECONDS
     # shrink the auto-picked curve point too: the default 128 MiB budget
     # would land it at 16k lanes — ~45 s of CPU sweeps in a smoke run
     os.environ.setdefault("MADSIM_CHUNK_BUDGET_BYTES", str(8 << 20))
@@ -861,6 +959,10 @@ def _smoke() -> None:
     STREAM_REPS = 1
     STREAM_SIM_SECONDS = 0.3
     STREAM_MAX_STEPS = 2_000
+    TELEM_SEEDS = 128
+    TELEM_CHUNK = 64
+    TELEM_REPS = 2
+    TELEM_SIM_SECONDS = 0.3
 
 
 if __name__ == "__main__":
@@ -874,5 +976,9 @@ if __name__ == "__main__":
         # the streaming leg standalone (the ≥1x-at-every-batch-size
         # acceptance figure, incl. the 65,536 sag point)
         print(json.dumps({"metric": "streaming_leg", **bench_streaming()}))
+    elif "--telemetry" in sys.argv:
+        # the telemetry-overhead leg standalone (the ≤3% gate on the
+        # streaming checked-sweep path)
+        print(json.dumps({"metric": "telemetry_leg", **bench_telemetry()}))
     else:
         main()

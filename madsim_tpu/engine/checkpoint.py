@@ -58,8 +58,35 @@ from .core import EngineConfig, EngineState, Workload
 #     results and queue position — hence the bump; this reader still
 #     ACCEPTS v6-v8 files (the leaf layout is unchanged; an old snapshot
 #     simply has no stream tag).
-_FORMAT_VERSION = 9
-_READABLE_VERSIONS = (6, 7, 8, 9)
+# v10: opt-in device-side EVENT-MIX plane (madsim_tpu/obs) — EngineState
+#     gained ``evmix`` as its LAST field, so every pre-v10 leaf index is
+#     unchanged and this reader still ACCEPTS v6-v9 files whenever the
+#     resuming workload leaves the plane disabled (width 0: the missing
+#     trailing leaf is substituted from ``like``). A v6-v9 snapshot
+#     CANNOT resume an event-mix-ENABLED sweep — the counters for the
+#     already-run steps were never recorded — and the reader rejects
+#     that combination instead of silently zero-filling.
+_FORMAT_VERSION = 10
+_READABLE_VERSIONS = (6, 7, 8, 9, 10)
+
+
+def _restore_leaf(data, i: int, leaf, path: str):
+    """One positional leaf of a snapshot, honoring the v10 compat rule:
+    a missing trailing leaf is legal ONLY when the resuming structure
+    expects a width-0 plane there (``leaf.size == 0``) — then ``like``'s
+    own empty leaf stands in for it."""
+    if f"leaf_{i}__key" in data:
+        return jax.random.wrap_key_data(jnp.asarray(data[f"leaf_{i}__key"]))
+    if f"leaf_{i}" in data:
+        return jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype)
+    if leaf.size == 0:
+        return jnp.asarray(leaf)
+    raise ValueError(
+        f"{path} has no leaf_{i} but the resuming state expects a "
+        f"non-empty array there (shape {leaf.shape}) — a pre-v10 "
+        "snapshot cannot resume an event-mix-enabled sweep "
+        "(engine/core.py event_mix_kinds); re-run from scratch"
+    )
 
 
 def save_sweep(
@@ -134,12 +161,9 @@ def load_sweep(path: str, like: EngineState) -> EngineState:
             "produce a fresh checkpoint)"
         )
     leaves, treedef = jax.tree.flatten(like)
-    out = []
-    for i, leaf in enumerate(leaves):
-        if f"leaf_{i}__key" in data:
-            out.append(jax.random.wrap_key_data(jnp.asarray(data[f"leaf_{i}__key"])))
-        else:
-            out.append(jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype))
+    out = [
+        _restore_leaf(data, i, leaf, path) for i, leaf in enumerate(leaves)
+    ]
     return jax.tree.unflatten(treedef, out)
 
 
@@ -207,21 +231,25 @@ def load_stream(path: str, like: EngineState):
             "(engine/stream.stream_sweep ckpt_path=)"
         )
     leaves, treedef = jax.tree.flatten(like)
-    out = []
-    for i, leaf in enumerate(leaves):
-        if f"leaf_{i}__key" in data:
-            out.append(
-                jax.random.wrap_key_data(jnp.asarray(data[f"leaf_{i}__key"]))
-            )
-        else:
-            out.append(jnp.asarray(data[f"leaf_{i}"], dtype=leaf.dtype))
+    out = [
+        _restore_leaf(data, i, leaf, path) for i, leaf in enumerate(leaves)
+    ]
     state = jax.tree.unflatten(treedef, out)
     meta = json.loads(bytes(bytearray(data["__stream__"])).decode())
     pending = {}
     susp = {}
     for idx, it in enumerate(meta["items"]):
+        # pre-v10 stream snapshots have no pend_{j} for the trailing
+        # evmix leaf; a width-0 plane row is an empty array of the
+        # like-leaf's per-lane shape (the only legal missing case —
+        # _restore_leaf already rejected non-empty gaps above)
         pending[int(it)] = [
-            data[f"pend_{j}"][idx] for j in range(len(leaves))
+            (
+                data[f"pend_{j}"][idx]
+                if f"pend_{j}" in data
+                else np.zeros(out[j].shape[1:], np.asarray(out[j]).dtype)
+            )
+            for j in range(len(leaves))
         ]
         bit = meta["susp"][idx]
         if bit is not None:
@@ -327,6 +355,7 @@ def run_sweep_chunked_resumable(
     chunk_size: int = 16384,
     run_chunk: Optional[Callable] = None,
     params=None,
+    telemetry=None,
 ) -> dict:
     """Pod-scale sweep that survives interruption at chunk granularity.
 
@@ -350,8 +379,14 @@ def run_sweep_chunked_resumable(
     (scripts/sweep_million.py ``--mesh``); the chunk files it writes are
     mesh-free (fingerprint + seed sha only), so a sweep can be
     interrupted under one device count and finished under another.
+
+    ``telemetry`` (``obs.Telemetry`` or None) records chunk wall time,
+    seeds-done progress and skip/resume events strictly OUT-OF-BAND:
+    every recorder sits behind an ``is not None`` guard and never touches
+    the summaries, so report bytes are identical with it on or off.
     """
     import os
+    import time as _time
 
     from .core import (
         _concat_finals, _pad_params, _pad_seeds, _slice_params, run_sweep,
@@ -384,7 +419,12 @@ def run_sweep_chunked_resumable(
         path = os.path.join(ckpt_dir, f"chunk_{lo:010d}_{k}.json")
         if os.path.exists(path):
             summary = _load_chunk_summary(path, first, last, seeds_sha, fp)
+            if telemetry is not None:
+                telemetry.count("sweep_chunks_skipped_total")
+                telemetry.event("chunk_skipped", lo=lo, k=k)
         else:
+            if telemetry is not None:
+                t_chunk = _time.perf_counter()
             # pad a ragged final chunk so it reuses the one compiled
             # sweep program (a fresh batch shape recompiles for seconds);
             # a limit-aware summarize (models/_common.make_sweep_summary)
@@ -410,6 +450,19 @@ def run_sweep_chunked_resumable(
                     final = _concat_finals(k, final)
                 summary = summarize(final)
             _write_chunk_summary(path, first, last, seeds_sha, fp, summary)
+            if telemetry is not None:
+                dt = _time.perf_counter() - t_chunk
+                telemetry.observe(
+                    "sweep_chunk_seconds", dt,
+                    help="device+summary wall time per chunk",
+                )
+                telemetry.count("sweep_chunks_total")
+                telemetry.event("chunk", lo=lo, k=k, wall_s=round(dt, 6))
+        if telemetry is not None:
+            telemetry.count(
+                "sweep_seeds_done_total", k, help="seeds merged so far"
+            )
+            telemetry.event_mix(summary)
         merge_summaries(totals, summary)
     return totals
 
@@ -431,6 +484,7 @@ def run_sweep_pipelined(
     pad_multiple: int = 1,
     on_chunk: Optional[Callable] = None,
     params=None,
+    telemetry=None,
 ) -> dict:
     """Chunked sweep with the host phase of chunk N overlapped against
     the device sweep of chunk N+1 — the driver that makes END-TO-END
@@ -494,8 +548,18 @@ def run_sweep_pipelined(
     lane slice, edge-padded like the seeds; the checkpoint fingerprint
     gains the params digest so one candidate's chunk files can never
     merge into another candidate's sweep.
+
+    ``telemetry`` (``obs.Telemetry`` or None) records chunk wall time,
+    host-phase time, seeds-done progress and skip/resume events, and —
+    when the handle carries a trace — one "device" span per chunk
+    (dispatch -> summary-done) with the previous chunk's "host" flush
+    span nested inside its wall window, which is exactly the overlap
+    picture Perfetto renders. Strictly OUT-OF-BAND: every recorder is
+    behind an ``is not None`` guard and summaries are never touched, so
+    the merged report is byte-identical with telemetry on or off.
     """
     import os
+    import time as _time
 
     from .core import (
         _concat_finals, _pad_params, _pad_seeds, _slice_params, run_sweep,
@@ -526,6 +590,7 @@ def run_sweep_pipelined(
         os.makedirs(ckpt_dir, exist_ok=True)
     supports_limit = bool(getattr(summarize, "supports_limit", False))
     resume_lo = int(resume_from[1]["lo"]) if resume_from is not None else -1
+    tracer = telemetry.tracer if telemetry is not None else None
 
     totals: dict = {}
     pending = None  # previous chunk awaiting its host phase
@@ -533,6 +598,9 @@ def run_sweep_pipelined(
 
     def flush(p) -> None:
         lo, k, sha, final, susp, summary, path = p
+        if telemetry is not None:
+            t_host = _time.perf_counter()
+            h0 = tracer._now_us() if tracer is not None else 0.0
         if host_work is not None:
             extra = host_work(
                 final,
@@ -550,6 +618,23 @@ def run_sweep_pipelined(
                 sha, fp, summary,
             )
         merge_summaries(totals, summary)
+        if telemetry is not None:
+            dt = _time.perf_counter() - t_host
+            telemetry.observe(
+                "sweep_host_phase_seconds", dt,
+                help="host phase (decode/check/ckpt write) per chunk",
+            )
+            telemetry.count("sweep_chunks_total")
+            telemetry.count(
+                "sweep_seeds_done_total", k, help="seeds merged so far"
+            )
+            telemetry.event_mix(summary)
+            telemetry.event("chunk", lo=lo, k=k, host_phase_s=round(dt, 6))
+            if tracer is not None:
+                tracer.complete(
+                    f"host flush lo={lo}", h0, tracer._now_us() - h0,
+                    track="host", args={"lo": lo, "k": k},
+                )
         if on_chunk is not None:
             on_chunk(lo=lo, k=k, summary=summary)
 
@@ -570,14 +655,28 @@ def run_sweep_pipelined(
                 flush(pending)  # keep merge order = seed order
                 pending = None
             merge_summaries(totals, summary)
+            if telemetry is not None:
+                telemetry.count("sweep_chunks_skipped_total")
+                telemetry.count("sweep_seeds_done_total", k)
+                telemetry.event_mix(summary)
+                telemetry.event("chunk_skipped", lo=lo, k=k)
             if on_chunk is not None:
                 on_chunk(lo=lo, k=k, summary=summary)
             continue
 
         # -- device phase: enqueue this chunk's sweep (+ screen) --------
+        if telemetry is not None:
+            t_disp = _time.perf_counter()
+            d0 = tracer._now_us() if tracer is not None else 0.0
         pad = chunk_size - k if n > chunk_size else -k % pad_multiple
         if lo == resume_lo:
             state, inflight = resume_from
+            if telemetry is not None:
+                telemetry.count(
+                    "sweep_resume_total",
+                    help="mid-chunk snapshot resumes",
+                )
+                telemetry.event("chunk_resumed", lo=lo, k=k)
             if int(inflight.get("k", k)) != k or not np.array_equal(
                 np.asarray(state.seed)[:k], seeds_host[lo : lo + k]
             ):
@@ -622,6 +721,20 @@ def run_sweep_pipelined(
             final = _concat_finals(k, final)
         if susp is not None and pad:
             susp = susp[:k]
+        if telemetry is not None:
+            # summarize() above synced on the device work, so this wall
+            # window (dispatch -> summary materialized) IS the device
+            # phase; the previous chunk's host flush ran inside it
+            dt = _time.perf_counter() - t_disp
+            telemetry.observe(
+                "sweep_chunk_seconds", dt,
+                help="device phase (dispatch -> summary) per chunk",
+            )
+            if tracer is not None:
+                tracer.complete(
+                    f"device chunk lo={lo}", d0, tracer._now_us() - d0,
+                    track="device", args={"lo": lo, "k": k},
+                )
         pending = (lo, k, sha, final, susp, summary, path)
         computed += 1
         if stop_after is not None and computed >= stop_after:
@@ -651,7 +764,9 @@ def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
     silently merge into a coverage-guided sweep as zero coverage.
     ``hist_slots`` is included for the same reason in reverse: a resized
     history buffer changes which seeds latch ``hist_overflow``, so their
-    chunk summaries are not interchangeable."""
+    chunk summaries are not interchangeable. ``event_mix_kinds`` is
+    included because enabling the plane adds the ``event_mix`` key to
+    every chunk summary (and disables pre-v10 snapshot reuse)."""
     from .core import hist_slots
 
     init = workload.init
@@ -663,4 +778,5 @@ def _sweep_fingerprint(workload: Workload, cfg: EngineConfig) -> str:
     return (
         f"{fn.__module__}.{fn.__qualname__}|{args!r}|{cfg_id!r}"
         f"|cover{workload.cover_bits}|hist{hist_slots(workload)}"
+        f"|emix{workload.event_mix_kinds}"
     )

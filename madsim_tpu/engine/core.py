@@ -104,6 +104,16 @@ class Workload(NamedTuple):
     # ``hist_slots == 0`` disables the plane entirely.
     record: Optional[Callable[..., Tuple[jnp.ndarray, jnp.ndarray]]] = None
     hist_slots: int = 0
+    # Opt-in device-side event-mix plane (madsim_tpu/obs): per-seed
+    # per-event-kind uint32 counters, one masked add per dispatched event
+    # (same in-step write discipline as the coverage plane). Kinds >=
+    # ``event_mix_kinds`` are simply not counted; 0 disables the plane
+    # entirely (width-0 arrays, no loop-carry cost). The chunk summary
+    # reduces it into an ``event_mix`` kind-histogram
+    # (models/_common.make_sweep_summary) — heartbeat storms, election
+    # churn and fault-window activity visible per sweep without host
+    # decode.
+    event_mix_kinds: int = 0
 
 
 def cover_words(workload: Workload) -> int:
@@ -160,6 +170,11 @@ class EngineState(NamedTuple):
     hist_overflow: jnp.ndarray  # bool sticky history-overflow flag
     queue: EventQueue
     wstate: Any  # workload pytree
+    # event-mix plane (uint32[event_mix_kinds], width 0 when disabled).
+    # LAST field on purpose: checkpoint leaves are stored positionally
+    # (checkpoint.py leaf_{i}), so appending after ``wstate`` keeps every
+    # pre-v10 leaf index stable and old snapshots loadable.
+    evmix: jnp.ndarray
 
 
 def _init_one(
@@ -205,6 +220,7 @@ def _init_one(
         hist_overflow=jnp.zeros((), bool),
         queue=q,
         wstate=wstate,
+        evmix=jnp.zeros((workload.event_mix_kinds,), jnp.uint32),
     )
 
 
@@ -305,6 +321,15 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         hist_len = hist_len + jnp.where(want & fits, 1, 0)
         hist_ov = hist_ov | (want & ~fits)
 
+    # event mix: count this event's kind — one masked [K]-sized add in
+    # the same step, the cheapest of the three opt-in planes (no callback,
+    # the popped ``kind`` is the index)
+    evmix = s.evmix
+    if workload.event_mix_kinds > 0:
+        k = workload.event_mix_kinds
+        slot = (jnp.arange(k, dtype=jnp.int32) == kind) & take
+        evmix = evmix + slot.astype(jnp.uint32)
+
     def sel(pred, new, old):
         return jax.tree.map(lambda a, b: jnp.where(pred, a, b), new, old)
 
@@ -323,6 +348,7 @@ def step_one(workload: Workload, cfg: EngineConfig, s: EngineState) -> EngineSta
         hist_overflow=hist_ov,
         queue=q,
         wstate=sel(take, wstate, s.wstate),
+        evmix=evmix,
     )
 
 

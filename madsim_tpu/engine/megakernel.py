@@ -508,4 +508,6 @@ def run_megasweep(state: EngineState, steps: int,
             acc=acc[:, 0],
             nsent=nsent[:, 0],
         ),
+        # probe workload defines no event-mix plane (event_mix_kinds=0)
+        evmix=state.evmix,
     )

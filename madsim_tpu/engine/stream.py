@@ -247,6 +247,7 @@ def stream_sweep(
     ckpt_path: Optional[str] = None,
     stop_after_rounds: Optional[int] = None,
     resume_from: Optional[str] = None,
+    telemetry=None,
 ) -> dict:
     """Sweep ``seeds`` through a constant-occupancy lane pool; returns
     the merged summary dict, byte-identical to ``run_sweep_pipelined``
@@ -276,7 +277,17 @@ def stream_sweep(
     - ``stats``: a caller-owned dict filled with wall-clock-side
       telemetry (``rounds``, ``refills``, ``lanes``, ``occupancy_mean``)
       — kept OUT of the returned totals so the report stays a pure
-      function of the work.
+      function of the work. Updated INCREMENTALLY (after every flush and
+      before every snapshot), so an interrupted or crashed run still
+      leaves occupancy records behind, not just a completed one.
+    - ``telemetry`` (``obs.Telemetry`` or None): per-round occupancy and
+      queue-depth gauges, round/refill-quorum/flush latency histograms,
+      retirement-flux and drain-tail counters, seeds-done progress, and
+      — when the handle carries a trace — "device" round spans with
+      "host" flush spans interleaved plus an occupancy counter track
+      (the refill-cadence picture). Strictly OUT-OF-BAND: every recorder
+      is behind an ``is not None`` guard; the report bytes are identical
+      with telemetry on or off.
 
     Interrupt/resume (checkpoint format v9): ``stop_after_rounds=R``
     snapshots pool + pending results + merged totals to ``ckpt_path``
@@ -284,9 +295,12 @@ def stream_sweep(
     ``resume_from=path`` continues — flushed chunks never recompute, and
     the final totals are bit-identical to the uninterrupted run.
     """
+    import time as _time
+
     from .checkpoint import _sweep_fingerprint, params_digest
     from ..models._common import merge_summaries  # lazy: models import us
 
+    tracer = telemetry.tracer if telemetry is not None else None
     seeds_host = np.asarray(jnp.asarray(seeds, jnp.int64))
     n = int(seeds_host.size)
     if n == 0:
@@ -489,6 +503,19 @@ def stream_sweep(
         )
         resume_pending = resume_susp = {}
 
+    def publish_stats():
+        """Surface the stream's internal telemetry NOW — called after
+        every flush and before every snapshot (not just at return), so
+        an interrupted run still has its occupancy record."""
+        if stats is not None:
+            stats.update(
+                rounds=int(rounds),
+                refills=int(refills),
+                lanes=int(L),
+                round_steps=int(round_steps),
+                occupancy_mean=(occ_sum / rounds if rounds else 0.0),
+            )
+
     def flush_ready():
         nonlocal next_flush_lo
         while next_flush_lo < n:
@@ -496,6 +523,9 @@ def stream_sweep(
             k = min(chunk_size, n - next_flush_lo)
             if c not in pend or not pend_have[c].all():
                 return
+            if telemetry is not None:
+                t_flush = _time.perf_counter()
+                f0 = tracer._now_us() if tracer is not None else 0.0
             chunk_state = _buf_state(pend.pop(c), treedef, keymask)
             pend_have.pop(c)
             sus = sus_buf.pop(c)
@@ -512,9 +542,30 @@ def stream_sweep(
                 if extra:
                     summary = {**summary, **extra}
             merge_summaries(totals, summary)
+            if telemetry is not None:
+                dt = _time.perf_counter() - t_flush
+                telemetry.observe(
+                    "stream_flush_seconds", dt,
+                    help="virtual-chunk flush (summary+host work)",
+                )
+                telemetry.count(
+                    "stream_seeds_done_total", k,
+                    help="seeds flushed into the merged report",
+                )
+                telemetry.event_mix(summary)
+                telemetry.event(
+                    "flush", lo=next_flush_lo, k=k, wall_s=round(dt, 6)
+                )
+                if tracer is not None:
+                    tracer.complete(
+                        f"flush lo={next_flush_lo}", f0,
+                        tracer._now_us() - f0, track="host",
+                        args={"lo": next_flush_lo, "k": k},
+                    )
             if on_chunk is not None:
                 on_chunk(lo=next_flush_lo, k=k, summary=summary)
             next_flush_lo += k
+            publish_stats()
 
     rounds_this_call = 0
     while True:
@@ -523,6 +574,26 @@ def stream_sweep(
             break
         assigned = int(np.count_nonzero(lane_item >= 0))
         occ_sum += assigned / L
+        if telemetry is not None:
+            t_round = _time.perf_counter()
+            r0 = tracer._now_us() if tracer is not None else 0.0
+            telemetry.gauge(
+                "stream_occupancy", assigned / L,
+                help="assigned lanes / pool size at round start",
+            )
+            telemetry.gauge(
+                "stream_queue_depth", n - next_q,
+                help="work items not yet dispatched onto lanes",
+            )
+            if next_q >= n:
+                telemetry.count(
+                    "stream_drain_rounds_total",
+                    help="rounds run after the queue went dry (drain tail)",
+                )
+            telemetry.sample(
+                "stream occupancy",
+                occupancy=assigned / L, queue_depth=n - next_q,
+            )
         # while the queue still has work, exit the round as soon as a
         # refill quorum (L/8 lanes) retires — retired lanes hand their
         # slots over instead of burning frozen steps to the round
@@ -541,10 +612,33 @@ def stream_sweep(
         rounds += 1
         rounds_this_call += 1
 
-        done = np.asarray(state.done)
+        done = np.asarray(state.done)  # syncs on the round program
+        if telemetry is not None:
+            telemetry.observe(
+                "stream_round_seconds", _time.perf_counter() - t_round,
+                help="device round (dispatch -> pool state on host)",
+            )
+            telemetry.count("stream_rounds_total")
+            if tracer is not None:
+                tracer.complete(
+                    f"round {rounds}", r0, tracer._now_us() - r0,
+                    track="device",
+                    args={"occupancy": assigned / L, "queue": n - next_q},
+                )
         ctr = np.asarray(state.ctr)
         retired = (lane_item >= 0) & (done | (ctr >= lane_budget))
         if retired.any():
+            if telemetry is not None:
+                telemetry.count(
+                    "stream_retired_total", int(retired.sum()),
+                    help="lanes retired (retirement flux)",
+                )
+                telemetry.observe(
+                    "stream_refill_quorum_seconds",
+                    _time.perf_counter() - t_round,
+                    help="round dispatch -> retirement cohort on host "
+                    "(refill quorum latency)",
+                )
             # one screen per retirement cohort, on the pool state; the
             # suspect bit is a pure per-lane function, so these bits are
             # exactly what a per-chunk screen would produce
@@ -565,6 +659,11 @@ def stream_sweep(
                 items_t = order[next_q : next_q + take]
                 next_q += take
                 refills += take
+                if telemetry is not None:
+                    telemetry.count(
+                        "stream_refills_total", take,
+                        help="lanes refilled from the work queue",
+                    )
                 lane_item[lanes_t] = items_t
                 lane_budget[lanes_t] = budgets_host[items_t]
                 pool_seeds[lanes_t] = seeds_host[items_t]
@@ -614,6 +713,12 @@ def stream_sweep(
             flush_ready()
             if next_flush_lo >= n:
                 break
+            publish_stats()  # snapshot leaves a current occupancy record
+            if telemetry is not None:
+                telemetry.event(
+                    "snapshot", rounds=int(rounds),
+                    next_flush_lo=int(next_flush_lo),
+                )
             from .checkpoint import save_stream
 
             # the v9 row format: item -> per-leaf rows (views into the
@@ -648,12 +753,5 @@ def stream_sweep(
             )
             break
 
-    if stats is not None:
-        stats.update(
-            rounds=int(rounds),
-            refills=int(refills),
-            lanes=int(L),
-            round_steps=int(round_steps),
-            occupancy_mean=(occ_sum / rounds if rounds else 0.0),
-        )
+    publish_stats()
     return totals

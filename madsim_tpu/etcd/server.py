@@ -32,11 +32,17 @@ class SimServerBuilder:
     def __init__(self) -> None:
         self._timeout_rate = 0.0
         self._service: Optional[EtcdService] = None
+        self._telemetry = None
 
     def timeout_rate(self, rate: float) -> "SimServerBuilder":
         """Fraction of requests that hang 5-15 s then fail Unavailable
         (server.rs:20-25)."""
         self._timeout_rate = rate
+        return self
+
+    def telemetry(self, telemetry) -> "SimServerBuilder":
+        """Attach an ``obs.Telemetry`` handle for wire-level metrics."""
+        self._telemetry = telemetry
         return self
 
     def load(self, dump: str) -> "SimServerBuilder":
@@ -48,7 +54,8 @@ class SimServerBuilder:
 
     async def serve(self, addr: "str | tuple") -> None:
         server = (self._server_cls or SimServer)(
-            self._service or EtcdService(), self._timeout_rate
+            self._service or EtcdService(), self._timeout_rate,
+            telemetry=self._telemetry,
         )
         await server.serve(addr)
 
@@ -71,9 +78,11 @@ class SimServer:
     async def _bind(addr: "str | tuple") -> Any:
         return await NetEndpoint.bind(addr)
 
-    def __init__(self, service: EtcdService, timeout_rate: float = 0.0):
+    def __init__(self, service: EtcdService, timeout_rate: float = 0.0,
+                 telemetry=None):
         self.service = service
         self.timeout_rate = timeout_rate
+        self.telemetry = telemetry
         #: set once the listener is bound (port-0 discovery, real mode)
         self.bound_addr: "Optional[tuple]" = None
 
@@ -92,6 +101,10 @@ class SimServer:
             self.service.tick()
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "etcd_connections_total", help="accepted connections"
+            )
         try:
             req = await rx.recv()
             if req is None:
@@ -107,6 +120,24 @@ class SimServer:
             tx.close()
 
     async def _handle(self, req: tuple, tx: Any, rx: Any) -> None:
+        if self.telemetry is None:
+            return await self._handle_op(req, tx, rx)
+        import time as _walltime
+
+        t0 = _walltime.perf_counter()
+        op = str(req[0]) if req else "?"
+        try:
+            return await self._handle_op(req, tx, rx)
+        finally:
+            self.telemetry.count(
+                "etcd_requests_total", help="requests served", op=op
+            )
+            self.telemetry.observe(
+                "etcd_api_seconds", _walltime.perf_counter() - t0,
+                help="per-op handling latency", op=op,
+            )
+
+    async def _handle_op(self, req: tuple, tx: Any, rx: Any) -> None:
         svc = self.service
         op = req[0]
         try:

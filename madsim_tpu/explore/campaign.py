@@ -245,6 +245,7 @@ def _sweep_candidate(
     mesh=None,
     on_chunk=None,
     envelope: Optional[FaultEnvelope] = None,
+    telemetry=None,
 ) -> dict:
     """Run one candidate's sweep over the pinned seed range; returns the
     merged summary dict (coverage_map + violating_seeds included).
@@ -331,13 +332,14 @@ def _sweep_candidate(
             workload, ecfg, seeds, target.summarize, mesh=mesh,
             host_work=host_work, screen=screen_fn, chunk_size=chunk_size,
             ckpt_dir=round_dir, on_chunk=on_chunk, params=params,
+            telemetry=telemetry,
         )
     from ..engine.checkpoint import run_sweep_pipelined
 
     return run_sweep_pipelined(
         workload, ecfg, seeds, target.summarize, host_work=host_work,
         screen=screen_fn, chunk_size=chunk_size, ckpt_dir=round_dir,
-        on_chunk=on_chunk, params=params,
+        on_chunk=on_chunk, params=params, telemetry=telemetry,
     )
 
 
@@ -347,6 +349,7 @@ def sweep_candidate_grid(
     ccfg: CampaignConfig,
     envelope: FaultEnvelope,
     mesh=None,
+    telemetry=None,
 ) -> List[dict]:
     """Sweep K candidates as ONE (candidate x seed) device grid and
     return each candidate's summary dict — identical values to K calls
@@ -427,6 +430,7 @@ def sweep_candidate_grid(
         params=params, chunk_size=s, pool_size=pool,
         host_work=host_work, screen=screen_fn, mesh=mesh,
         on_chunk=lambda *, lo, k, summary: summaries.append(summary),
+        telemetry=telemetry,
     )
     return summaries
 
@@ -439,6 +443,7 @@ def run_campaign(
     ckpt_dir: Optional[str] = None,
     mesh=None,
     on_chunk=None,
+    telemetry=None,
 ) -> CampaignResult:
     """Drive the find loop: ``rounds`` candidates from ``base_spec``.
 
@@ -474,13 +479,26 @@ def run_campaign(
     (``sweep_candidate_grid``); grid blocks skip per-round sweep
     checkpointing and per-chunk ``on_chunk`` callbacks (``ckpt_dir``
     and ``on_chunk`` apply to serial rounds only — a grid block is one
-    launch, not a chunk stream)."""
+    launch, not a chunk stream).
+
+    ``telemetry`` (``obs.Telemetry`` or None) rides through to every
+    round's sweep driver and adds the campaign view: candidates/s,
+    corpus size and global coverage-bit gauges, unique-vs-duplicate
+    failure counters (the dedup hit rate), time-to-first-bug, and one
+    journal record per round. Strictly OUT-OF-BAND — the JSONL report
+    bytes are identical with telemetry on or off (the determinism gate
+    runs both ways)."""
+    import time as _time
+
     rng = random.Random(ccfg.campaign_seed)
     corpus: List[object] = []
     records: List[dict] = []
     failures: List[Tuple[object, int]] = []
     seen_failures = set()
     global_map: List[int] = []
+    t0_wall = _time.perf_counter()
+    vio_seen = vio_unique = 0  # dedup-hit-rate inputs (telemetry only)
+    first_bug_recorded = False
 
     header = {
         "campaign": ccfg._asdict(),
@@ -509,7 +527,7 @@ def run_campaign(
     def absorb(r: int, parent, spec, summary: dict) -> bool:
         """Fold one candidate's summary into corpus/coverage/records;
         True = the failure budget is spent (stop the campaign)."""
-        nonlocal global_map
+        nonlocal global_map, vio_seen, vio_unique, first_bug_recorded
         cand_map = [int(w) for w in summary.get("coverage_map", [])]
         if len(global_map) < len(cand_map):
             global_map = global_map + [0] * (len(cand_map) - len(global_map))
@@ -522,11 +540,13 @@ def run_campaign(
             global_map = [g | c for g, c in zip(global_map, cand_map)]
 
         vio = summary.get("violating_seeds", [])[: ccfg.max_recorded_seeds]
+        fresh = 0
         for seed in vio:
             key = (spec, seed)
             if key not in seen_failures:
                 seen_failures.add(key)
                 failures.append((spec, seed))
+                fresh += 1
 
         records.append(
             {
@@ -543,6 +563,53 @@ def run_campaign(
                 "events_total": int(summary.get("events_total", 0)),
             }
         )
+        if telemetry is not None:
+            elapsed = _time.perf_counter() - t0_wall
+            vio_seen += len(vio)
+            vio_unique += fresh
+            telemetry.count(
+                "campaign_candidates_total", help="candidates swept"
+            )
+            telemetry.gauge(
+                "campaign_candidates_per_s",
+                (r + 1) / max(elapsed, 1e-9),
+                help="campaign throughput since start",
+            )
+            telemetry.gauge(
+                "campaign_corpus_size", len(corpus),
+                help="retained specs in the corpus",
+            )
+            telemetry.gauge(
+                "campaign_coverage_bits", coverage_bit_count(global_map),
+                help="global coverage union population count",
+            )
+            if fresh:
+                telemetry.count(
+                    "campaign_failures_total", fresh,
+                    help="unique (spec, seed) failures",
+                )
+            if len(vio) - fresh:
+                telemetry.count(
+                    "campaign_failure_dupes_total", len(vio) - fresh,
+                    help="violating seeds already in the dedup set",
+                )
+            if vio_seen:
+                telemetry.gauge(
+                    "campaign_dedup_hit_rate",
+                    (vio_seen - vio_unique) / vio_seen,
+                    help="fraction of observed failures already known",
+                )
+            if failures and not first_bug_recorded:
+                first_bug_recorded = True
+                telemetry.gauge(
+                    "campaign_time_to_first_bug_seconds", elapsed,
+                    help="wall time from campaign start to first failure",
+                )
+            telemetry.event(
+                "round", round=r, retained=bool(retained),
+                new_bits=int(new_bits), violations=len(vio),
+                corpus=len(corpus),
+            )
         return bool(
             ccfg.stop_after_failures
             and len(failures) >= ccfg.stop_after_failures
@@ -563,6 +630,7 @@ def run_campaign(
             specs += [specs[-1]] * (ccfg.batch - len(block))
             summaries = sweep_candidate_grid(
                 target, specs, ccfg, envelope, mesh=mesh,
+                telemetry=telemetry,
             )[: len(block)]
             for (parent, spec), summary in zip(block, summaries):
                 stop = absorb(r, parent, spec, summary)
@@ -576,7 +644,7 @@ def run_campaign(
             )
             summary = _sweep_candidate(
                 target, spec, ccfg, round_dir, mesh=mesh, on_chunk=on_chunk,
-                envelope=envelope,
+                envelope=envelope, telemetry=telemetry,
             )
             stop = absorb(r, parent, spec, summary)
             r += 1

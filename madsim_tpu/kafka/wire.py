@@ -490,6 +490,24 @@ SUPPORTED_APIS: Dict[int, Tuple[int, int, Optional[int]]] = {
     API_DELETE_TOPICS: (0, 3, None),
 }
 
+#: api_key -> wire name, for telemetry labels (obs/metrics.py)
+API_NAMES: Dict[int, str] = {
+    API_PRODUCE: "Produce",
+    API_FETCH: "Fetch",
+    API_LIST_OFFSETS: "ListOffsets",
+    API_METADATA: "Metadata",
+    API_OFFSET_COMMIT: "OffsetCommit",
+    API_OFFSET_FETCH: "OffsetFetch",
+    API_FIND_COORDINATOR: "FindCoordinator",
+    API_JOIN_GROUP: "JoinGroup",
+    API_HEARTBEAT: "Heartbeat",
+    API_LEAVE_GROUP: "LeaveGroup",
+    API_SYNC_GROUP: "SyncGroup",
+    API_VERSIONS: "ApiVersions",
+    API_CREATE_TOPICS: "CreateTopics",
+    API_DELETE_TOPICS: "DeleteTopics",
+}
+
 ERR_NONE = 0
 ERR_OFFSET_OUT_OF_RANGE = 1
 ERR_UNKNOWN_TOPIC_OR_PARTITION = 3
@@ -539,10 +557,13 @@ class KafkaWire:
         broker: Optional[Broker] = None,
         clock_ms: Callable[[], int] = lambda: 0,
         advertised: Tuple[str, int] = ("127.0.0.1", 9092),
+        telemetry=None,
     ):
         self.broker = broker or Broker()
         self.clock_ms = clock_ms
         self.advertised = advertised
+        self.telemetry = telemetry  # obs.Telemetry or None (frames/s,
+        # per-API latency — wall-clock side, never in a response byte)
         self._now = 0  # per-frame clock sample
         #: (group, member) -> (protocol_name, metadata bytes) for the
         #: JoinGroup member-metadata echo the classic protocol shape needs
@@ -558,6 +579,30 @@ class KafkaWire:
         Produce). Raises :class:`WireError` on frames this server cannot
         serve in kind — the transport drops the connection, as a real
         broker does."""
+        if self.telemetry is None:
+            return self._handle_frame(frame)
+        import time as _walltime
+
+        t0 = _walltime.perf_counter()
+        api = (
+            int.from_bytes(frame[:2], "big", signed=True)
+            if len(frame) >= 2
+            else -1
+        )
+        name = API_NAMES.get(api, str(api))
+        try:
+            return self._handle_frame(frame)
+        finally:
+            self.telemetry.count(
+                "kafka_frames_total", help="request frames served",
+                api=name,
+            )
+            self.telemetry.observe(
+                "kafka_api_seconds", _walltime.perf_counter() - t0,
+                help="per-API handling latency", api=name,
+            )
+
+    def _handle_frame(self, frame: bytes) -> Optional[bytes]:
         r = Reader(frame)
         api = r.i16()
         version = r.i16()
@@ -1227,8 +1272,9 @@ class SimWireServer:
     The sim twin of :class:`WireServer`, mirroring how ``kafka/server.py``
     and ``real/kafka.py`` split the legacy dispatcher."""
 
-    def __init__(self, broker: Optional[Broker] = None):
+    def __init__(self, broker: Optional[Broker] = None, telemetry=None):
         self.broker = broker or Broker()
+        self.telemetry = telemetry
         self.wire: Optional[KafkaWire] = None
         self.bound_addr: Optional[Tuple[str, int]] = None
 
@@ -1244,13 +1290,20 @@ class SimWireServer:
 
         ep = await Endpoint.bind(addr)
         self.bound_addr = ep.local_addr()
-        self.wire = KafkaWire(self.broker, self._now_ms, self.bound_addr)
+        self.wire = KafkaWire(
+            self.broker, self._now_ms, self.bound_addr,
+            telemetry=self.telemetry,
+        )
         while True:
             tx, rx, _src = await ep.accept1()
             mstask.spawn(self._serve_conn(tx, rx), name="kafka-wire-conn")
 
     async def _serve_conn(self, tx: Any, rx: Any) -> None:
         buf = FrameBuffer()
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "kafka_connections_total", help="accepted connections"
+            )
         try:
             while True:
                 chunk = await rx.recv()
@@ -1277,8 +1330,9 @@ class WireServer:
     timestamps) — what ``real.kafka.SimBroker.serve`` now runs by
     default, and what a stock client connects to."""
 
-    def __init__(self, broker: Optional[Broker] = None):
+    def __init__(self, broker: Optional[Broker] = None, telemetry=None):
         self.broker = broker or Broker()
+        self.telemetry = telemetry
         self.wire: Optional[KafkaWire] = None
         self.bound_addr: Optional[Tuple[str, int]] = None
         self._server = None
@@ -1297,7 +1351,10 @@ class WireServer:
         host, port = parse_addr(addr)
         self._server = await asyncio.start_server(self._conn, host, port)
         self.bound_addr = self._server.sockets[0].getsockname()[:2]
-        self.wire = KafkaWire(self.broker, self._now_ms, self.bound_addr)
+        self.wire = KafkaWire(
+            self.broker, self._now_ms, self.bound_addr,
+            telemetry=self.telemetry,
+        )
 
     async def serve(self, addr: "str | tuple") -> None:
         await self.start(addr)
@@ -1311,6 +1368,10 @@ class WireServer:
     async def _conn(self, reader, writer) -> None:
         from ..real.stream import read_frame_raw, write_frame_raw
 
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "kafka_connections_total", help="accepted connections"
+            )
         try:
             while True:
                 req = await read_frame_raw(reader)

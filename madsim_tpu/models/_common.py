@@ -28,17 +28,29 @@ MAX_KEYS = frozenset({"queue_high_water"})
 # covered by the sweep iff any chunk covered it)
 OR_KEYS = frozenset({"coverage_map"})
 
+# keys that merge by elementwise ADD (fixed-width count vectors: the
+# engine's event-mix kind histogram sums across chunks, not concatenates)
+VEC_KEYS = frozenset({"event_mix"})
+
 
 def merge_summaries(totals: dict, summary: dict) -> dict:
     """Fold one chunk's ``sweep_summary`` dict into a running total.
 
     Keys are additive counts except ``MAX_KEYS`` (high-water marks),
-    ``OR_KEYS`` (bitmap words, elementwise OR), and list values
-    (concatenated — e.g. per-chunk violating-seed samples). Mutates and
-    returns ``totals`` (start with ``{}``)."""
+    ``OR_KEYS`` (bitmap words, elementwise OR), ``VEC_KEYS`` (count
+    vectors, elementwise add), and list values (concatenated — e.g.
+    per-chunk violating-seed samples). Mutates and returns ``totals``
+    (start with ``{}``)."""
     for k, v in summary.items():
         if k in MAX_KEYS:
             totals[k] = max(totals.get(k, 0), v)
+        elif k in VEC_KEYS:
+            old = totals.get(k, [])
+            if len(old) < len(v):
+                old = old + [0] * (len(v) - len(old))
+            totals[k] = [
+                a + b for a, b in zip(old, list(v) + [0] * (len(old) - len(v)))
+            ]
         elif k in OR_KEYS:
             old = totals.get(k, [])
             if len(old) < len(v):
@@ -150,7 +162,14 @@ def make_sweep_summary(
         bits = (cover[:, :, None] >> shifts) & jnp.uint32(1)  # [S, W, 32]
         union = jnp.sum(jnp.max(bits, axis=0) << shifts, axis=1,
                         dtype=jnp.uint32)
-        return jnp.stack(cols), union
+        # the opt-in event-mix plane rides along too: per-seed per-kind
+        # uint32 counters summed down the batch axis to one [K] vector
+        # (width 0 when the workload doesn't enable it — free)
+        emix = final.evmix
+        if m is not None:
+            emix = jnp.where(m[:, None], emix, jnp.uint32(0))
+        emix = jnp.sum(emix.astype(jnp.int64), axis=0)
+        return jnp.stack(cols), union, emix
 
     _summarize = jax.jit(lambda final: _reduce(final, None))
 
@@ -169,16 +188,20 @@ def make_sweep_summary(
         final chunk costs no recompile (engine/checkpoint.py drivers
         and scripts/sweep_million.py rely on this)."""
         if limit is None:
-            vec, union = _summarize(final)
+            vec, union, emix = _summarize(final)
             seeds = int(final.seed.shape[0])
         else:
-            vec, union = _summarize_limit(final, jnp.asarray(limit, jnp.int32))
+            vec, union, emix = _summarize_limit(
+                final, jnp.asarray(limit, jnp.int32)
+            )
             seeds = int(limit)
         vec = np.asarray(vec)
         out = {"seeds": seeds}
         out.update((n, int(v)) for n, v in zip(names, vec))
         if union.shape[0]:
             out["coverage_map"] = [int(w) for w in np.asarray(union)]
+        if emix.shape[0]:
+            out["event_mix"] = [int(v) for v in np.asarray(emix)]
         return out
 
     # the chunk drivers key program-reuse decisions on this marker

@@ -136,6 +136,12 @@ class RaftConfig(NamedTuple):
     faults: Optional[
         Union[efaults.FaultSpec, efaults.FixedFaults, efaults.FaultEnvelope]
     ] = None
+    # opt-in device-side event-mix telemetry plane (madsim_tpu/obs):
+    # per-seed uint32 counters, one per event kind (N_KINDS), summarized
+    # into the chunk's ``event_mix`` histogram. Changes the summary
+    # schema and the checkpoint fingerprint — off by default so stock
+    # sweeps stay byte-identical.
+    event_mix: bool = False
 
 
 def fault_spec(cfg: RaftConfig) -> efaults.FaultSpec:
@@ -888,6 +894,7 @@ def workload(cfg: RaftConfig = None) -> Workload:
         probe=_probe,
         record=partial(_record, cfg) if cfg.hist_slots > 0 else None,
         hist_slots=cfg.hist_slots,
+        event_mix_kinds=N_KINDS if cfg.event_mix else 0,
     )
 
 

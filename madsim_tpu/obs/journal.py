@@ -1,0 +1,87 @@
+"""The run journal: an append-only JSONL telemetry stream.
+
+One line per event, each carrying a wall-clock timestamp and the run ID
+— the ONLY place in the repo where wall-clock time is written to disk
+next to sweep results. The journal is explicitly excluded from the
+deterministic report bytes: reports (chunk summaries, campaign JSONL,
+checked-sweep totals) are pure functions of the work and never read or
+embed journal content; ``scripts/check_determinism.sh`` byte-diffs the
+reports with the journal enabled vs disabled to pin that invariant.
+
+Line shape (sorted keys)::
+
+    {"kind": "stream_flush", "lo": 0, "k": 32, "run": "9f2c...", "ts": 1722950400.123456}
+
+``kind`` names the event, ``run`` the run ID (one per Telemetry handle),
+``ts`` seconds since the epoch. Everything else is the event's own
+payload — JSON-able scalars only; the writer rejects nothing and repairs
+nothing, so emit clean values.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+
+def new_run_id() -> str:
+    """A fresh 16-hex-char run ID (collision-safe across hosts: random
+    bytes, not a timestamp)."""
+    return os.urandom(8).hex()
+
+
+class Journal:
+    """Append-only JSONL writer; every ``write`` is one flushed line, so
+    an interrupted run keeps every event up to the kill."""
+
+    def __init__(self, path: str, run_id: Optional[str] = None):
+        self.path = path
+        self.run_id = run_id or new_run_id()
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        self.write("run_start")
+
+    def write(self, kind: str, **fields) -> None:
+        rec = dict(fields)
+        rec["kind"] = kind
+        rec["run"] = self.run_id
+        rec["ts"] = round(time.time(), 6)
+        line = json.dumps(rec, sort_keys=True)
+        with self._lock:
+            if self._f.closed:
+                return  # post-close writes are dropped, not crashes
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if not self._f.closed:
+                self._f.write(
+                    json.dumps(
+                        {
+                            "kind": "run_end",
+                            "run": self.run_id,
+                            "ts": round(time.time(), 6),
+                        },
+                        sort_keys=True,
+                    )
+                    + "\n"
+                )
+                self._f.close()
+
+
+def read_journal(path: str):
+    """Parse a journal back into a list of dicts (tests, post-mortems)."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if line:
+                out.append(json.loads(line))
+    return out

@@ -352,6 +352,7 @@ def history_host_work(
     max_states: int = 200_000,
     workers: int = 0,
     max_recorded: int = 32,
+    telemetry=None,
 ) -> Callable:
     """Build the ``host_work`` callback for a screened checked sweep
     (engine/checkpoint.run_sweep_pipelined): decode the suspect lanes,
@@ -370,14 +371,20 @@ def history_host_work(
     Determinism contract: the returned dict is a pure function of the
     chunk's history planes — worker count changes wall-clock only, never
     a byte of the report (results are ordered by lane, dedup keys on
-    content, and each verdict is a pure function of one history)."""
+    content, and each verdict is a pure function of one history).
+    ``telemetry`` (``obs.Telemetry`` or None) records the suspect rate,
+    the canonical-dedup ratio, WGL pool utilization and check wall time
+    per chunk — out-of-band, never a byte of the returned dict."""
     import hashlib
+    import time as _time
 
     from .check import check_histories
     from .history import decode_lanes, history_canonical_bytes
 
     def host_work(final, *, lo, n, seeds, suspect, summary):
         del lo, seeds, summary
+        if telemetry is not None:
+            t_check = _time.perf_counter()
         if suspect is None:
             lanes = np.arange(n)
         else:
@@ -399,6 +406,36 @@ def history_host_work(
         results = [rep_results[rep[k]] for k in keys]
         bad = [int(h.seed) for h, r in zip(hists, results) if not r.ok]
         undecided = sum(1 for r in results if not r.decided)
+        if telemetry is not None:
+            telemetry.count("oracle_screened_total", int(n))
+            telemetry.count("oracle_suspects_total", int(lanes.size))
+            telemetry.count("oracle_unique_total", len(reps))
+            if bad:
+                telemetry.count("oracle_violations_total", len(bad))
+            telemetry.gauge(
+                "oracle_suspect_rate", lanes.size / max(n, 1),
+                help="suspect lanes / screened lanes, last chunk",
+            )
+            if lanes.size:
+                telemetry.gauge(
+                    "oracle_dedup_ratio", len(reps) / lanes.size,
+                    help="unique canonical histories / suspects "
+                    "(lower = more dedup wins)",
+                )
+            if workers > 0 and reps:
+                # load-balance proxy: busy slots / pool slots over the
+                # batch's -(-len // workers) waves
+                waves = -(-len(reps) // workers)
+                telemetry.gauge(
+                    "oracle_pool_utilization",
+                    len(reps) / (workers * waves),
+                    help="checked histories / (workers x waves), "
+                    "last chunk",
+                )
+            telemetry.observe(
+                "oracle_check_seconds", _time.perf_counter() - t_check,
+                help="decode+dedup+WGL check per chunk",
+            )
         return {
             "hist_screened": int(n),
             "hist_suspects": int(lanes.size),
@@ -429,6 +466,7 @@ def checked_sweep(
     max_recorded: int = 32,
     on_chunk=None,
     driver: str = "chunked",
+    telemetry=None,
 ) -> dict:
     """End-to-end checked sweep: pipelined chunked sweep + on-device
     screening + process-pool WGL checking, merged into one summary dict.
@@ -476,7 +514,7 @@ def checked_sweep(
         screen_fn = lambda final: screen_sweep(final, spec, mesh=mesh)  # noqa: E731
     host_work = history_host_work(
         spec, max_states=max_states, workers=workers,
-        max_recorded=max_recorded,
+        max_recorded=max_recorded, telemetry=telemetry,
     )
     if driver == "stream":
         from ..engine.core import pick_chunk_size
@@ -506,6 +544,7 @@ def checked_sweep(
             workload, cfg, seeds, summarize,
             chunk_size=chunk_size, host_work=host_work,
             screen=screen_fn, mesh=mesh, on_chunk=on_chunk,
+            telemetry=telemetry,
         )
     elif mesh is not None:
         from ..parallel.mesh import run_sweep_sharded_pipelined
@@ -516,6 +555,7 @@ def checked_sweep(
             chunk_per_device=chunk_per_device, chunk_size=chunk_size,
             ckpt_dir=ckpt_dir, stop_after=stop_after,
             resume_from=resume_from, on_chunk=on_chunk,
+            telemetry=telemetry,
         )
     else:
         if chunk_size is None:
@@ -534,6 +574,7 @@ def checked_sweep(
             stop_after=stop_after,
             resume_from=resume_from,
             on_chunk=on_chunk,
+            telemetry=telemetry,
         )
     if "hist_violating_seeds" in totals:
         totals["hist_violating_seeds"] = totals["hist_violating_seeds"][

@@ -276,6 +276,7 @@ def run_sweep_sharded_pipelined(
     resume_from: Optional[Tuple[EngineState, dict]] = None,
     on_chunk: Optional[Callable] = None,
     params=None,
+    telemetry=None,
 ) -> dict:
     """The pipelined checked-sweep driver lifted onto the mesh: chunked
     device sweeps run sharded over all devices (``run_sweep_sharded``),
@@ -302,7 +303,17 @@ def run_sweep_sharded_pipelined(
     resumes bit-identical on ANY mesh whose size divides the chunk —
     interrupt on 8 devices, resume on 1 (``resume_from=(state, inflight)``,
     with ``chunk_size`` taken from the snapshot's mesh layout).
+
+    ``telemetry`` (``obs.Telemetry`` or None) rides through to the inner
+    pipelined driver (chunk/host-phase timing, device/host trace spans)
+    and adds the mesh-level view: a ``mesh_devices`` gauge and a
+    PER-DEVICE seeds/s gauge sampled at each chunk merge. The per-step
+    psum'd live count stays inside the compiled round — surfacing it
+    per iteration would put host work on the step path; chunk-granule
+    throughput is the out-of-band proxy.
     """
+    import time as _time
+
     from ..engine.checkpoint import run_sweep_pipelined
     from ..engine.core import pick_chunk_size
 
@@ -328,6 +339,24 @@ def run_sweep_sharded_pipelined(
         run_chunk = lambda chunk, pchunk: run_sweep_sharded(  # noqa: E731
             workload, cfg, chunk, mesh, params=pchunk
         )
+    if telemetry is not None:
+        telemetry.gauge(
+            "mesh_devices", n_dev, help="devices in the sweep mesh"
+        )
+        inner_on_chunk = on_chunk
+        t_last = [_time.perf_counter()]
+
+        def on_chunk(lo, k, summary):
+            now = _time.perf_counter()
+            dt, t_last[0] = now - t_last[0], now
+            telemetry.gauge(
+                "mesh_seeds_per_s_per_device",
+                k / max(dt, 1e-9) / n_dev,
+                help="chunk-merge throughput divided by device count",
+            )
+            if inner_on_chunk is not None:
+                inner_on_chunk(lo=lo, k=k, summary=summary)
+
     return run_sweep_pipelined(
         workload,
         cfg,
@@ -346,4 +375,5 @@ def run_sweep_sharded_pipelined(
         pad_multiple=n_dev,
         on_chunk=on_chunk,
         params=params,
+        telemetry=telemetry,
     )

@@ -125,8 +125,9 @@ _REASON = {200: "OK", 204: "No Content", 400: "Bad Request",
 class WireServer:
     """Serve an :class:`S3Service` over S3 REST on a real TCP port."""
 
-    def __init__(self, service: Optional[S3Service] = None):
+    def __init__(self, service: Optional[S3Service] = None, telemetry=None):
         self.service = service or S3Service()
+        self.telemetry = telemetry
         self.bound_addr: Optional[Tuple[str, int]] = None
         self._server: Optional[asyncio.AbstractServer] = None
 
@@ -145,11 +146,17 @@ class WireServer:
 
     async def _conn(self, reader: asyncio.StreamReader,
                     writer: asyncio.StreamWriter) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "s3_connections_total", help="accepted connections"
+            )
         try:
             while True:
                 req = await self._read_request(reader)
                 if req is None:
                     return
+                t0 = (_walltime.perf_counter()
+                      if self.telemetry is not None else 0.0)
                 try:
                     rsp = self._dispatch(req)
                 except S3Error as e:
@@ -165,6 +172,17 @@ class WireServer:
                         _xml("Error",
                              "<Code>InternalError</Code>"
                              f"<Message>{_esc(str(e))}</Message>"),
+                    )
+                if self.telemetry is not None:
+                    self.telemetry.count(
+                        "s3_requests_total", help="requests served",
+                        method=req.method,
+                    )
+                    self.telemetry.observe(
+                        "s3_api_seconds",
+                        _walltime.perf_counter() - t0,
+                        help="per-request handling latency",
+                        method=req.method,
                     )
                 await self._write_response(writer, req, rsp)
         except (ConnectionError, asyncio.IncompleteReadError):

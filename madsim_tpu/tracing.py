@@ -10,12 +10,23 @@ Beyond the reference (which has no trace exporter), ``Tracer`` records
 per-task poll spans and emits the Chrome trace-event JSON format
 (chrome://tracing / Perfetto), with virtual time as the timeline — a
 practical way to *see* a schedule when debugging a failing seed.
+
+``SpanTracer`` scales the same exporter from one seed's polls to the
+FLEET drivers (madsim_tpu/obs): wall-clock phase spans on named tracks
+("device", "host", "stream", "checkers"), so one trace file shows the
+device sweep of chunk N overlapping the host decode/check of chunk N−1,
+the stream pool's round/refill cadence, and the checker-pool fan-out.
+Same JSON shape, same viewers; only the clock differs (virtual ns for
+``Tracer``, wall µs since construction for ``SpanTracer``).
 """
 
 from __future__ import annotations
 
 import json
 import logging
+import threading
+import time as _walltime
+from contextlib import contextmanager
 from typing import Any, Dict, List, Optional
 
 from . import context
@@ -101,6 +112,129 @@ class Tracer:
                 if int(node.id) not in named:
                     self._name_node(node)
         return json.dumps({"traceEvents": self.events})
+
+    def save(self, path: str) -> None:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+
+
+class SpanTracer:
+    """Chrome-trace recorder for DRIVER phases: wall-clock complete
+    events ("X") on named tracks, plus counter events ("C") for series
+    like pool occupancy — the fleet-scale sibling of :class:`Tracer`.
+
+    Tracks are lazily numbered in first-use order and named through "M"
+    ``thread_name`` metadata, so Perfetto shows "device" / "host" /
+    "stream" rows instead of bare thread ids. Timestamps are wall
+    microseconds since construction (Chrome's unit). Thread-safe: the
+    checker pool and the HTTP exporter may emit concurrently.
+    """
+
+    PID = 0  # one logical process: the driver
+
+    def __init__(self) -> None:
+        self.events: List[Dict[str, Any]] = [
+            {
+                "name": "process_name",
+                "ph": "M",
+                "pid": self.PID,
+                "args": {"name": "madsim_tpu driver"},
+            }
+        ]
+        self._t0 = _walltime.perf_counter_ns()
+        self._tracks: Dict[str, int] = {}
+        self._lock = threading.Lock()
+
+    def _now_us(self) -> float:
+        return (_walltime.perf_counter_ns() - self._t0) / 1000.0
+
+    def _tid(self, track: str) -> int:
+        tid = self._tracks.get(track)
+        if tid is None:
+            tid = self._tracks[track] = len(self._tracks)
+            self.events.append(
+                {
+                    "name": "thread_name",
+                    "ph": "M",
+                    "pid": self.PID,
+                    "tid": tid,
+                    "args": {"name": track},
+                }
+            )
+        return tid
+
+    def complete(
+        self,
+        name: str,
+        start_us: float,
+        dur_us: float,
+        track: str = "host",
+        cat: str = "phase",
+        args: Optional[dict] = None,
+    ) -> None:
+        """One finished span from precomputed times (µs since t0)."""
+        with self._lock:
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "pid": self.PID,
+                "tid": self._tid(track),
+                "ts": start_us,
+                "dur": max(dur_us, 0.001),
+            }
+            if args:
+                ev["args"] = args
+            self.events.append(ev)
+
+    @contextmanager
+    def span(
+        self,
+        name: str,
+        track: str = "host",
+        cat: str = "phase",
+        args: Optional[dict] = None,
+    ):
+        """Record the wrapped block as one complete event on ``track``."""
+        start = self._now_us()
+        try:
+            yield self
+        finally:
+            self.complete(
+                name, start, self._now_us() - start, track, cat, args
+            )
+
+    def instant(self, name: str, track: str = "host", args=None) -> None:
+        with self._lock:
+            ev = {
+                "name": name,
+                "ph": "i",
+                "s": "t",  # thread-scoped instant
+                "pid": self.PID,
+                "tid": self._tid(track),
+                "ts": self._now_us(),
+            }
+            if args:
+                ev["args"] = args
+            self.events.append(ev)
+
+    def counter(self, name: str, **values: float) -> None:
+        """One sample of a counter series (occupancy, queue depth) —
+        Perfetto renders these as a step chart over the trace."""
+        with self._lock:
+            self.events.append(
+                {
+                    "name": name,
+                    "ph": "C",
+                    "pid": self.PID,
+                    "ts": self._now_us(),
+                    "args": {k: float(v) for k, v in values.items()},
+                }
+            )
+
+    def to_json(self) -> str:
+        with self._lock:
+            return json.dumps({"traceEvents": list(self.events)})
 
     def save(self, path: str) -> None:
         with open(path, "w") as f:

@@ -10,6 +10,9 @@
 # both the sweep and the traced-replay path, so the same (spec, seed)
 # must yield byte-identical canonical history bytes across the two
 # processes AND across the two paths (docs/oracle.md contract).
+# A telemetry leg re-runs the streaming checked sweep and the campaign
+# under a full obs.Telemetry handle and byte-diffs against the
+# uninstrumented reports (docs/observability.md out-of-band contract).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -177,6 +180,40 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     echo "determinism gate: FAILED — streaming checked-sweep reports differ from chunked or are empty" >&2
     for f in "$out"/cs_*stream*.json; do echo "--- $f"; cat "$f"; done >&2 || true
     cat "$out"/cs_*stream*.log >&2 || true
+    exit 1
+  fi
+
+  # telemetry leg (docs/observability.md): telemetry must be strictly
+  # OUT-OF-BAND — the checked-sweep report (streaming driver, the most
+  # instrumented path) and the campaign JSONL must be byte-identical
+  # with a full obs.Telemetry handle (metrics + journal + trace) vs
+  # none, across two processes. Journal/trace files carry wall clocks
+  # and run IDs BY DESIGN and are excluded from the diff; the reports
+  # never embed them.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/checked_sweep_demo.py \
+      --seeds 96 --chunk-size 32 --workers 0 --driver stream \
+      --telemetry-dir "$out/obs_cs_$r" \
+      --report "$out/cs_${r}_telem.json" >"$out/cs_${r}_telem.log" 2>&1
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/explore_demo.py \
+      --rounds 2 --seeds-per-round 64 --campaign-seed 0 --no-shrink \
+      --telemetry-dir "$out/obs_ex_$r" \
+      --report "$out/${r}_telem.jsonl" >"$out/${r}_telem.log" 2>&1 || true
+  done
+  if [ -s "$out/cs_a_telem.json" ] \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_telem.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_telem.json" \
+    && [ -s "$out/a_telem.jsonl" ] \
+    && cmp -s "$out/a.jsonl" "$out/a_telem.jsonl" \
+    && cmp -s "$out/a.jsonl" "$out/b_telem.jsonl" \
+    && [ -s "$out/obs_cs_a/journal.jsonl" ] \
+    && [ -s "$out/obs_cs_a/trace.json" ]; then
+    echo "determinism gate: OK (telemetry on/off x 2 processes, byte-identical reports)"
+  else
+    echo "determinism gate: FAILED — telemetry changed report bytes (or wrote no journal/trace)" >&2
+    diff "$out/cs_a_w0.json" "$out/cs_a_telem.json" >&2 || true
+    diff "$out/a.jsonl" "$out/a_telem.jsonl" >&2 || true
+    cat "$out"/cs_*_telem.log "$out"/?_telem.log >&2 || true
     exit 1
   fi
 

@@ -16,7 +16,13 @@ stderr, where the gate ignores it.
 
 Usage: python scripts/checked_sweep_demo.py [--seeds N] [--chunk-size C]
            [--workers W] [--clean] [--report PATH] [--mesh N]
-           [--driver chunked|stream]
+           [--driver chunked|stream] [--telemetry-dir DIR]
+
+``--telemetry-dir DIR`` runs the identical pipeline under a full
+``obs.Telemetry`` handle (metrics + journal + trace spans written to
+DIR) — the report must be byte-identical to an uninstrumented run; the
+gate's telemetry leg runs 2 processes x telemetry {on, off} and diffs
+all four.
 
 ``--driver stream`` routes the identical pipeline through the
 persistent streaming lane pool (``engine.stream.stream_sweep``,
@@ -62,6 +68,12 @@ def main() -> int:
         help="sweep driver; the report bytes must not depend on this "
         "(the streaming leg of check_determinism.sh diffs the two)",
     )
+    ap.add_argument(
+        "--telemetry-dir", default=None,
+        help="run under a full obs.Telemetry handle (metrics + journal + "
+        "trace written HERE); the report bytes must not depend on this "
+        "(the telemetry leg of check_determinism.sh diffs on vs off)",
+    )
     args = ap.parse_args()
 
     mesh = None
@@ -87,13 +99,25 @@ def main() -> int:
         args.seed0, args.seed0 + args.seeds, dtype=jnp.int64
     )
 
+    telem = None
+    if args.telemetry_dir:
+        from madsim_tpu import obs
+
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        telem = obs.Telemetry(
+            journal=os.path.join(args.telemetry_dir, "journal.jsonl"),
+            trace=os.path.join(args.telemetry_dir, "trace.json"),
+        )
+
     t0 = time.perf_counter()
     totals = checked_sweep(
         wl, ecfg, seeds, etcd.history_spec(), etcd.sweep_summary,
         chunk_size=args.chunk_size, workers=args.workers, mesh=mesh,
-        driver=args.driver,
+        driver=args.driver, telemetry=telem,
     )
     wall = time.perf_counter() - t0
+    if telem is not None:
+        telem.close()
 
     report = {
         "metric": "etcd_checked_sweep",

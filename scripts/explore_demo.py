@@ -42,6 +42,10 @@ def main() -> None:
                          "campaign, then FAIL unless the full campaign "
                          "runs with 0 XLA compilations (the spec-as-data "
                          "contract, docs/faults.md)")
+    ap.add_argument("--telemetry-dir", type=str, default=None,
+                    help="run the campaign under a full obs.Telemetry "
+                         "handle (metrics + journal written here); must "
+                         "not change a report byte (docs/observability.md)")
     args = ap.parse_args()
 
     import time
@@ -70,11 +74,21 @@ def main() -> None:
         # run needs (envelope-keyed sweep, summary, pipeline glue) —
         # every later candidate is data, not a new jit key
         explore.run_campaign(target, bland, ccfg._replace(rounds=1))
+    telem = None
+    if args.telemetry_dir:
+        from madsim_tpu import obs
+
+        os.makedirs(args.telemetry_dir, exist_ok=True)
+        telem = obs.Telemetry(
+            journal=os.path.join(args.telemetry_dir, "journal.jsonl"),
+        )
     with count_compiles() as compiles:
         result = explore.run_campaign(
             target, bland, ccfg, report_path=args.report,
-            ckpt_dir=args.ckpt_dir,
+            ckpt_dir=args.ckpt_dir, telemetry=telem,
         )
+    if telem is not None:
+        telem.close()
     out = {
         "metric": "explore_demo",
         "rounds_run": len(result.records),

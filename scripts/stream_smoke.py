@@ -12,7 +12,11 @@ CPU backend, small enough for `make stest`:
    snapshot and resuming reproduces the uninterrupted totals exactly;
 4. zero-compile: a warmed stream over a fresh seed range performs 0 XLA
    compilations (`engine/compiles.count_compiles`), and occupancy stays
-   high (the whole point of continuous refill).
+   high (the whole point of continuous refill);
+5. telemetry rides along out-of-band: the first leg runs under an
+   `obs.Telemetry` handle and its registry drives the progress heartbeat
+   (seeds done, seeds/s, occupancy, ETA on stderr) — with the report
+   bytes still equal to the uninstrumented chunked run.
 """
 
 from __future__ import annotations
@@ -32,6 +36,7 @@ import numpy as np  # noqa: E402
 
 
 def main() -> int:
+    from madsim_tpu import obs
     from madsim_tpu.engine.checkpoint import run_sweep_pipelined
     from madsim_tpu.engine.compiles import count_compiles
     from madsim_tpu.engine.stream import stream_sweep
@@ -50,9 +55,19 @@ def main() -> int:
     t0 = time.perf_counter()
     chunked = run_sweep_pipelined(wl, ecfg, seeds, etcd.sweep_summary, **kw)
     stats: dict = {}
+    # the obs-registry heartbeat (satellite of docs/observability.md):
+    # the stream driver counts stream_seeds_done_total / sets
+    # stream_occupancy as it runs, and the heartbeat prints from those
+    # series — the telemetry must NOT change the report (asserted below)
+    telem = obs.Telemetry()
+    hb = obs.Heartbeat(telem.registry, len(seeds), prefix="stream")
     streamed = stream_sweep(
         wl, ecfg, seeds, etcd.sweep_summary, pool_size=32, round_steps=256,
-        stats=stats, **kw,
+        stats=stats, telemetry=telem, **kw,
+    )
+    hb_line = hb.tick(force=True)
+    assert hb_line is not None and f"{len(seeds)}/{len(seeds)}" in hb_line, (
+        f"heartbeat did not see the registry's seed count: {hb_line!r}"
     )
     assert streamed == chunked, (
         f"stream totals diverge from chunked:\n{streamed}\nvs\n{chunked}"
@@ -61,7 +76,7 @@ def main() -> int:
         f"stream == chunked: OK ({streamed['hist_violations']} violations, "
         f"{streamed['hist_unique']}/{streamed['hist_suspects']} unique "
         f"suspects, occupancy {stats['occupancy_mean']:.3f} over "
-        f"{stats['rounds']} rounds)"
+        f"{stats['rounds']} rounds, telemetry out-of-band)"
     )
 
     order = np.random.default_rng(7).permutation(len(seeds))

@@ -16,6 +16,10 @@ while the timed loop runs.
 
 Usage: python scripts/sweep_million.py [total_seeds] [ckpt_dir] [--mesh [N]]
 
+Progress goes to stderr as an obs-registry heartbeat (seeds done,
+seeds/s, ETA) every ``MADSIM_HB_SECONDS`` (default 5; 0 disables) —
+stdout stays the single machine-readable JSON line.
+
 With ``ckpt_dir`` the sweep is preemption-safe: per-chunk summaries are
 checkpointed (engine.checkpoint.run_sweep_chunked_resumable) and a
 restarted run skips completed chunks.
@@ -41,6 +45,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 import jax
 import jax.numpy as jnp
 
+from madsim_tpu import obs
 from madsim_tpu.engine import core
 from madsim_tpu.engine.compiles import count_compiles
 from madsim_tpu.models import raft
@@ -49,6 +54,8 @@ from madsim_tpu.models._common import merge_summaries
 # env-overridable so smoke runs can exercise the multi-chunk + ragged
 # paths without paying for 16k-lane compiles
 CHUNK = int(os.environ.get("MADSIM_SWEEP_CHUNK", 16384))
+# heartbeat cadence (stderr; stdout stays the one JSON line). 0 disables.
+HB_SECONDS = float(os.environ.get("MADSIM_HB_SECONDS", 5.0))
 
 
 def main() -> None:
@@ -103,46 +110,79 @@ def main() -> None:
     if tail:
         raft.sweep_summary(warm, limit=tail)
 
+    # progress heartbeat driven by the obs registry (seeds done, seeds/s,
+    # ETA), replacing ad-hoc perf_counter prints: the chunk drivers count
+    # ``sweep_seeds_done_total`` as each chunk lands, and a daemon ticker
+    # reads it back every HB_SECONDS — the same series a Prometheus
+    # scrape would see (obs.Telemetry(http_port=...))
+    telem = obs.Telemetry()
+    hb = obs.Heartbeat(telem.registry, total, prefix="sweep")
+    hb_stop = None
+    if HB_SECONDS > 0:
+        import threading
+
+        hb_stop = threading.Event()
+
+        def _beat():
+            while not hb_stop.wait(HB_SECONDS):
+                hb.tick()
+
+        threading.Thread(target=_beat, daemon=True, name="hb").start()
+
     ckpt_dir = ns.ckpt_dir
     chunks_preloaded = 0
-    with count_compiles() as compiles:
-        t0 = time.perf_counter()
-        if ckpt_dir:
-            import glob
+    try:
+        with count_compiles() as compiles:
+            t0 = time.perf_counter()
+            if ckpt_dir:
+                import glob
 
-            from madsim_tpu.engine.checkpoint import (
-                run_sweep_chunked_resumable,
-            )
+                from madsim_tpu.engine.checkpoint import (
+                    run_sweep_chunked_resumable,
+                )
 
-            chunks_preloaded = len(
-                glob.glob(os.path.join(ckpt_dir, "chunk_*.json"))
-            )
-            seeds = jnp.arange(base, base + total, dtype=jnp.int64)
-            # clamp the chunk granule to the total so a sub-chunk run is
-            # not padded up to a full 16k-lane sweep
-            totals = run_sweep_chunked_resumable(
-                wl, ecfg, seeds, raft.sweep_summary, ckpt_dir,
-                chunk_size=min(CHUNK, total), run_chunk=run_chunk,
-            )
-        else:
-            totals = {}
-            for lo in range(base, base + total, CHUNK):
-                k = min(CHUNK, base + total - lo)
-                if k < CHUNK and total > CHUNK:
-                    # ragged tail: extend the contiguous seed range to
-                    # the compiled chunk shape (value-identical to
-                    # core._pad_seeds' max+1+i filler) and mask the
-                    # padded lanes inside the one compiled summary
-                    # program — no trim program, no recompile, not even
-                    # an eager pad op
-                    final = run_chunk(
-                        jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
+                chunks_preloaded = len(
+                    glob.glob(os.path.join(ckpt_dir, "chunk_*.json"))
+                )
+                seeds = jnp.arange(base, base + total, dtype=jnp.int64)
+                # clamp the chunk granule to the total so a sub-chunk run
+                # is not padded up to a full 16k-lane sweep
+                totals = run_sweep_chunked_resumable(
+                    wl, ecfg, seeds, raft.sweep_summary, ckpt_dir,
+                    chunk_size=min(CHUNK, total), run_chunk=run_chunk,
+                    telemetry=telem,
+                )
+            else:
+                totals = {}
+                for lo in range(base, base + total, CHUNK):
+                    k = min(CHUNK, base + total - lo)
+                    if k < CHUNK and total > CHUNK:
+                        # ragged tail: extend the contiguous seed range
+                        # to the compiled chunk shape (value-identical to
+                        # core._pad_seeds' max+1+i filler) and mask the
+                        # padded lanes inside the one compiled summary
+                        # program — no trim program, no recompile, not
+                        # even an eager pad op
+                        final = run_chunk(
+                            jnp.arange(lo, lo + CHUNK, dtype=jnp.int64)
+                        )
+                        merge_summaries(
+                            totals, raft.sweep_summary(final, limit=k)
+                        )
+                    else:
+                        final = run_chunk(
+                            jnp.arange(lo, lo + k, dtype=jnp.int64)
+                        )
+                        merge_summaries(totals, raft.sweep_summary(final))
+                    telem.count(
+                        "sweep_seeds_done_total", k,
+                        help="seeds retired across all chunks",
                     )
-                    merge_summaries(totals, raft.sweep_summary(final, limit=k))
-                else:
-                    final = run_chunk(jnp.arange(lo, lo + k, dtype=jnp.int64))
-                    merge_summaries(totals, raft.sweep_summary(final))
-        wall = time.perf_counter() - t0
+            wall = time.perf_counter() - t0
+    finally:
+        if hb_stop is not None:
+            hb_stop.set()
+    hb.tick(force=True)
 
     print(
         json.dumps(
