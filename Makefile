@@ -37,6 +37,11 @@
 #                      with visible device/host overlap + stream refill
 #                      cadence, run journal, live /metrics endpoint,
 #                      device-side event-mix plane
+#   make fleet-smoke   crash-safe fleet orchestrator (docs/fleet.md):
+#                      shared corpus store across two processes ==
+#                      solo bytes, strictly more fingerprints than
+#                      either worker alone, kill -9 mid-append + lease
+#                      reclaim, regression-replay gate
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -60,7 +65,8 @@ PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
 	explore-smoke oracle-smoke differential-smoke wire-smoke \
-	multichip-smoke stream-smoke obs-smoke dryrun bench-smoke test-all
+	multichip-smoke stream-smoke obs-smoke fleet-smoke dryrun \
+	bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -113,8 +119,14 @@ stream-smoke:
 obs-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/obs_smoke.py
 
+# the crash-safe fleet orchestrator (docs/fleet.md): solo-vs-shared-store
+# merged-report byte identity, two workers strictly beating either alone,
+# kill -9 mid-append + lease reclaim, regression-replay gate
+fleet-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
+
 stest: test determinism explore-smoke oracle-smoke differential-smoke \
-	wire-smoke multichip-smoke stream-smoke obs-smoke
+	wire-smoke multichip-smoke stream-smoke obs-smoke fleet-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

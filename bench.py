@@ -835,6 +835,37 @@ def bench_secondary_models():
     )
 
 
+def bench_carryover() -> dict:
+    """The carry-over leg standalone (``--carryover``): re-run exactly
+    the two measurements earlier rounds left flagged — the kafka/etcd
+    interleaved spread gate (``spread_ok`` must hold round over round)
+    and the auto-picked chunk-size batch-curve point (the auto pick
+    must stay at or left of the occupancy knee) — without paying for
+    the full pipeline. Recorded per round in ``BENCH_rNN.json``."""
+    global CURVE
+    from madsim_tpu.engine import core  # noqa: F401  (x64 setup)
+    from madsim_tpu.models import raft
+
+    cfg = raft.RaftConfig(num_nodes=5, crashes=1)
+    ecfg = raft.engine_config(cfg, time_limit_ns=int(SIM_SECONDS * 1e9))
+    wl = raft.workload(cfg)
+    saved = CURVE
+    CURVE = ()  # bench_curve unions in the auto pick: one point, flagged
+    try:
+        curve = bench_curve(wl, ecfg, raft)
+    finally:
+        CURVE = saved
+    kafka_line, etcd_line = bench_secondary_models()
+    return {
+        "auto_chunk_point": next(p for p in curve if p["auto_chunk"]),
+        "kafka": kafka_line,
+        "etcd": etcd_line,
+        "spread_gate": SPREAD_GATE,
+        "spread_ok": kafka_line["spread_ok"] and etcd_line["spread_ok"],
+        "backend": jax.default_backend(),
+    }
+
+
 def main() -> None:
     from madsim_tpu.engine import core  # noqa: F401  (x64 setup)
     from madsim_tpu.models import raft
@@ -980,5 +1011,9 @@ if __name__ == "__main__":
         # the telemetry-overhead leg standalone (the ≤3% gate on the
         # streaming checked-sweep path)
         print(json.dumps({"metric": "telemetry_leg", **bench_telemetry()}))
+    elif "--carryover" in sys.argv:
+        # the flagged-legs re-run (kafka/etcd spread gate + auto_chunk
+        # curve point) for the per-round BENCH_rNN.json record
+        print(json.dumps({"metric": "carryover_leg", **bench_carryover()}))
     else:
         main()

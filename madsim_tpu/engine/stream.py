@@ -247,6 +247,7 @@ def stream_sweep(
     ckpt_path: Optional[str] = None,
     stop_after_rounds: Optional[int] = None,
     resume_from: Optional[str] = None,
+    feed: Optional[Callable[[], Optional[dict]]] = None,
     telemetry=None,
 ) -> dict:
     """Sweep ``seeds`` through a constant-occupancy lane pool; returns
@@ -294,6 +295,21 @@ def stream_sweep(
     after R rounds this call and returns the (partial) totals;
     ``resume_from=path`` continues — flushed chunks never recompute, and
     the final totals are bit-identical to the uninterrupted run.
+
+    In-flight queue feed: ``feed`` is a nullary callable polled whenever
+    free lanes outnumber queued items. It returns ``None`` (nothing more
+    — the stream drains and returns) or a segment dict
+    ``{"seeds": int[m], "params": rows or None, "budgets": int[m] or
+    absent}`` appended to the work queue WITHOUT leaving the pool: fed
+    lanes enter through the same traced refill programs, so a fleet
+    worker's newly leased batches start at zero recompiles. Segments
+    (and the initial ``seeds``) must be multiples of ``chunk_size`` —
+    fed chunks flush in arrival order with the same virtual-chunk bytes
+    as passing the concatenated queue up front (pinned by
+    tests/test_stream.py). ``feed`` is incompatible with
+    ``queue_order`` and with checkpointing (``ckpt_path``/
+    ``resume_from``): the queue is open-ended, so there is no fixed
+    submission order to permute or fingerprint.
     """
     import time as _time
 
@@ -340,6 +356,19 @@ def stream_sweep(
     )
     if not np.array_equal(np.sort(order), np.arange(n)):
         raise ValueError("queue_order must be a permutation of range(n)")
+    if feed is not None:
+        if queue_order is not None:
+            raise ValueError("feed is incompatible with queue_order")
+        if resume_from is not None or ckpt_path is not None:
+            raise ValueError(
+                "feed is incompatible with checkpointing "
+                "(ckpt_path/resume_from)"
+            )
+        if n % chunk_size:
+            raise ValueError(
+                f"with feed, the initial seeds must be a multiple of "
+                f"chunk_size={chunk_size}, got {n}"
+            )
     params_host = (
         None if params is None else jax.tree.map(np.asarray, params)
     )
@@ -567,11 +596,138 @@ def stream_sweep(
             next_flush_lo += k
             publish_stats()
 
+    def poll_feed():
+        """One feed poll: extend the open-ended work queue with a fed
+        segment. False when feed is absent or dry — the stream then
+        drains and returns as usual. Growing the host-side queue arrays
+        never touches the pool: fed items reach lanes through the same
+        traced refill programs, at zero recompiles."""
+        nonlocal n, seeds_host, budgets_host, order, params_host
+        if feed is None:
+            return False
+        seg = feed()
+        if seg is None:
+            return False
+        new_seeds = np.asarray(jnp.asarray(seg["seeds"], jnp.int64)).ravel()
+        m = int(new_seeds.size)
+        if m == 0 or m % chunk_size:
+            raise ValueError(
+                f"fed segment must be a non-empty multiple of "
+                f"chunk_size={chunk_size}, got {m} seeds"
+            )
+        if (seg.get("params") is None) != (params_host is None):
+            raise ValueError(
+                "fed segment params presence must match the stream's"
+            )
+        nb = seg.get("budgets")
+        nb = (
+            np.full(m, cfg.max_steps, np.int32)
+            if nb is None
+            else np.asarray(nb, np.int32)
+        )
+        if nb.shape != (m,):
+            raise ValueError(
+                f"fed budgets must be shape ({m},), got {nb.shape}"
+            )
+        seeds_host = np.concatenate([seeds_host, new_seeds])
+        budgets_host = np.concatenate([budgets_host, nb])
+        order = np.concatenate(
+            [order, np.arange(n, n + m, dtype=np.int64)]
+        )
+        if params_host is not None:
+            params_host = jax.tree.map(
+                lambda a, b: np.concatenate([a, np.asarray(b)]),
+                params_host, seg["params"],
+            )
+        n += m
+        if telemetry is not None:
+            telemetry.count(
+                "stream_feed_segments_total",
+                help="work segments fed into the running stream",
+            )
+            telemetry.count(
+                "stream_feed_items_total", m,
+                help="work items fed into the running stream",
+            )
+        return True
+
+    def dispatch_free():
+        """Assign free lanes from the queue, polling ``feed`` for more
+        whenever the queue runs dry while lanes sit free — the point
+        where a fleet worker's newly leased batches enter the running
+        pool, mid-flight."""
+        nonlocal next_q, refills, state
+        while True:
+            free = np.nonzero(lane_item < 0)[0]
+            if free.size == 0:
+                return
+            if next_q >= n and not poll_feed():
+                return
+            take = min(int(free.size), n - next_q)
+            if take == 0:
+                return
+            lanes_t = free[:take]
+            items_t = order[next_q : next_q + take]
+            next_q += take
+            refills += take
+            if telemetry is not None:
+                telemetry.count(
+                    "stream_refills_total", take,
+                    help="lanes refilled from the work queue",
+                )
+            lane_item[lanes_t] = items_t
+            lane_budget[lanes_t] = budgets_host[items_t]
+            pool_seeds[lanes_t] = seeds_host[items_t]
+            if pool_params is not None:
+                for p, s in zip(
+                    jax.tree.leaves(pool_params),
+                    jax.tree.leaves(params_host),
+                ):
+                    p[lanes_t] = s[items_t]
+            if mesh is None:
+                # fixed-width row refill: init exactly quorum-many
+                # fresh lanes per event (padding short cohorts with
+                # duplicates of their first lane), so total init
+                # work is one init per item — same as chunked
+                w = max(1, L // 8)
+                for off in range(0, take, w):
+                    sub = lanes_t[off : off + w]
+                    idx = np.concatenate(
+                        [sub, np.full(w - sub.size, sub[0], sub.dtype)]
+                    )
+                    state = _refill_rows(
+                        workload, cfg, state,
+                        jnp.asarray(idx, jnp.int32),
+                        jnp.asarray(pool_seeds[idx]),
+                        None
+                        if pool_params is None
+                        else jax.tree.map(
+                            lambda a: jnp.asarray(a[idx]), pool_params
+                        ),
+                    )
+            else:
+                # mesh path: full-pool masked re-init keeps the
+                # refill shape independent of the mesh layout
+                mask = np.zeros(L, bool)
+                mask[lanes_t] = True
+                state = _refill(
+                    workload, cfg, state,
+                    place_pool(mask),
+                    place_pool(pool_seeds),
+                    place_params(pool_params),
+                )
+
     rounds_this_call = 0
     while True:
         flush_ready()
         if next_flush_lo >= n:
-            break
+            # everything queued so far is flushed; only a fed segment
+            # can extend the stream now (all lanes are free, so the
+            # dispatch below must land work or we are done)
+            if not poll_feed():
+                break
+            dispatch_free()
+            continue
         assigned = int(np.count_nonzero(lane_item >= 0))
         occ_sum += assigned / L
         if telemetry is not None:
@@ -597,8 +753,14 @@ def stream_sweep(
         # while the queue still has work, exit the round as soon as a
         # refill quorum (L/8 lanes) retires — retired lanes hand their
         # slots over instead of burning frozen steps to the round
-        # boundary; once the queue is dry, drain to the end
-        stop = max(assigned - max(1, L // 8), 0) if next_q < n else 0
+        # boundary; once the queue is dry, drain to the end (with a
+        # feed, quorum exits persist: more work may arrive at any
+        # retirement, so slots keep turning over)
+        stop = (
+            max(assigned - max(1, L // 8), 0)
+            if (next_q < n or feed is not None)
+            else 0
+        )
         budget_dev = jnp.asarray(lane_budget)
         stop_dev = jnp.asarray([stop], jnp.int32)
         if mesh is None:
@@ -652,59 +814,7 @@ def stream_sweep(
             )
             lane_item[idx] = -1
             lane_budget[idx] = 0  # freeze until refilled
-            free = np.nonzero(lane_item < 0)[0]
-            take = min(int(free.size), n - next_q)
-            if take:
-                lanes_t = free[:take]
-                items_t = order[next_q : next_q + take]
-                next_q += take
-                refills += take
-                if telemetry is not None:
-                    telemetry.count(
-                        "stream_refills_total", take,
-                        help="lanes refilled from the work queue",
-                    )
-                lane_item[lanes_t] = items_t
-                lane_budget[lanes_t] = budgets_host[items_t]
-                pool_seeds[lanes_t] = seeds_host[items_t]
-                if pool_params is not None:
-                    for p, s in zip(
-                        jax.tree.leaves(pool_params),
-                        jax.tree.leaves(params_host),
-                    ):
-                        p[lanes_t] = s[items_t]
-                if mesh is None:
-                    # fixed-width row refill: init exactly quorum-many
-                    # fresh lanes per event (padding short cohorts with
-                    # duplicates of their first lane), so total init
-                    # work is one init per item — same as chunked
-                    w = max(1, L // 8)
-                    for off in range(0, take, w):
-                        sub = lanes_t[off : off + w]
-                        idx = np.concatenate(
-                            [sub, np.full(w - sub.size, sub[0], sub.dtype)]
-                        )
-                        state = _refill_rows(
-                            workload, cfg, state,
-                            jnp.asarray(idx, jnp.int32),
-                            jnp.asarray(pool_seeds[idx]),
-                            None
-                            if pool_params is None
-                            else jax.tree.map(
-                                lambda a: jnp.asarray(a[idx]), pool_params
-                            ),
-                        )
-                else:
-                    # mesh path: full-pool masked re-init keeps the
-                    # refill shape independent of the mesh layout
-                    mask = np.zeros(L, bool)
-                    mask[lanes_t] = True
-                    state = _refill(
-                        workload, cfg, state,
-                        place_pool(mask),
-                        place_pool(pool_seeds),
-                        place_params(pool_params),
-                    )
+            dispatch_free()
 
         if (
             stop_after_rounds is not None

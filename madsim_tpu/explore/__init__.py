@@ -24,6 +24,11 @@ Testing*):
 - ``fleet`` — fleet scale: device-count throughput/time-to-first-bug
   curves and million-seed campaigns routed through the sharded
   pipelined driver (``parallel.mesh``; see ``docs/multichip.md``).
+- ``store`` / ``orchestrator`` — the crash-safe fleet tier: a shared
+  byte-deterministic corpus/bug store (sha-guarded append-only logs,
+  quarantine, expiring leases) and the leased-unit worker loop feeding
+  ``stream_sweep`` in flight, with the regression-replay gate that
+  keeps every stored bug reproducing forever (``docs/fleet.md``).
 - ``differential`` — host↔device differential validation: run the
   device raft model and ``examples/raft_host.py`` over matched
   ``(spec, seed)`` grids (one compiled fault schedule drives both
@@ -46,6 +51,14 @@ from .campaign import (  # noqa: F401
     target_envelope,
 )
 from .fleet import checked_sweep_curve, sharded_campaign  # noqa: F401
+from .orchestrator import (  # noqa: F401
+    merged_report,
+    plan_unit,
+    regression_gate,
+    run_worker,
+    write_merged,
+)
+from .store import CorpusStore, Lease, ReadStats  # noqa: F401
 from .differential import (  # noqa: F401
     DifferentialConfig,
     TierOutcome,

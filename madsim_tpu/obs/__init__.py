@@ -31,7 +31,12 @@ import time
 from contextlib import nullcontext
 from typing import Optional
 
-from .journal import Journal, new_run_id, read_journal  # noqa: F401
+from .journal import (  # noqa: F401
+    Journal,
+    JournalRecords,
+    new_run_id,
+    read_journal,
+)
 from .metrics import (  # noqa: F401
     Counter,
     Gauge,

@@ -76,12 +76,39 @@ class Journal:
                 self._f.close()
 
 
-def read_journal(path: str):
-    """Parse a journal back into a list of dicts (tests, post-mortems)."""
-    out = []
+class JournalRecords(list):
+    """``read_journal``'s return value: a plain list of record dicts,
+    plus ``truncated`` — True when the file ended in a torn partial
+    line (a writer killed mid-append) whose bytes were dropped. The
+    valid prefix is always returned; only the torn tail is lost."""
+
+    truncated: bool = False
+
+
+def read_journal(path: str) -> JournalRecords:
+    """Parse a journal back into a list of dicts (tests, post-mortems).
+
+    Crash-tolerant by design: the journal is an append-only stream whose
+    writer may die mid-line (``kill -9`` between ``write`` and
+    ``flush`` landing), so a torn/partial FINAL line is normal operating
+    data, not corruption — the valid prefix is returned with
+    ``.truncated`` set instead of raising ``JSONDecodeError``. A
+    malformed line with MORE data after it is genuine corruption (a torn
+    line can only be last in an append-only file) and still raises."""
+    out = JournalRecords()
     with open(path) as f:
-        for line in f:
-            line = line.strip()
-            if line:
-                out.append(json.loads(line))
+        lines = f.read().split("\n")
+    # a well-formed file ends "...}\n" -> a trailing "" entry; anything
+    # else in the final slot is a torn partial record
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if i == len(lines) - 1:
+                out.truncated = True
+                return out
+            raise
     return out

@@ -13,6 +13,9 @@
 # A telemetry leg re-runs the streaming checked sweep and the campaign
 # under a full obs.Telemetry handle and byte-diffs against the
 # uninstrumented reports (docs/observability.md out-of-band contract).
+# A fleet leg runs the leased-unit orchestrator over a shared corpus
+# store with 1 and 2 workers, twice each, and byte-diffs the merged
+# report across all four runs (docs/fleet.md merge contract).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -260,6 +263,31 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     diff "$out/da.json" "$out/db.json" >&2 || true
     echo "--- differential_demo run logs ---" >&2
     cat "$out/da.log" "$out/db.log" >&2 || true
+    exit 1
+  fi
+
+  # fleet leg (docs/fleet.md): the merged fleet corpus report must be
+  # byte-identical across two driver processes x two worker counts —
+  # how many workers leased which units, in what order, and whether a
+  # lease ever expired may change wall-clock only, never a merged byte
+  # (min-combine over the record union is partition-invariant).
+  for w in 1 2; do
+    for r in a b; do
+      JAX_PLATFORMS=cpu "${PY:-python}" scripts/fleet_smoke.py \
+        --merged-only --workers "$w" \
+        --report "$out/fleet_${r}_w${w}.jsonl" \
+        >"$out/fleet_${r}_w${w}.log" 2>&1
+    done
+  done
+  if [ -s "$out/fleet_a_w1.jsonl" ] \
+    && cmp -s "$out/fleet_a_w1.jsonl" "$out/fleet_b_w1.jsonl" \
+    && cmp -s "$out/fleet_a_w1.jsonl" "$out/fleet_a_w2.jsonl" \
+    && cmp -s "$out/fleet_a_w1.jsonl" "$out/fleet_b_w2.jsonl"; then
+    echo "determinism gate: OK (fleet merged corpus, 2 processes x 2 worker counts, byte-identical)"
+  else
+    echo "determinism gate: FAILED — fleet merged reports differ or are empty" >&2
+    for f in "$out"/fleet_*.jsonl; do echo "--- $f"; cat "$f"; done >&2 || true
+    cat "$out"/fleet_*.log >&2 || true
     exit 1
   fi
 else

@@ -1,0 +1,236 @@
+"""Fleet orchestrator smoke: shared store, kill -9 reclaim, replay gate.
+
+Usage:
+    python scripts/fleet_smoke.py [--outdir DIR]          # the full drill
+    python scripts/fleet_smoke.py --merged-only --workers N --report PATH
+    (internal) python scripts/fleet_smoke.py --worker --store DIR ...
+
+The full drill (``make fleet-smoke``) checks the ISSUE-16 "Done" bar
+end to end, every leg in a SEPARATE process:
+
+1. a solo worker sweeps the whole unit plan into a fresh store and
+   writes the merged fleet report — the reference bytes;
+2. two independent workers share a second store (the first capped to
+   half the units, so each genuinely runs only part of the plan): the
+   merged report must be BYTE-IDENTICAL to the solo run, and the merged
+   distinct-fingerprint count must be STRICTLY greater than what either
+   worker found alone;
+3. the kill drill: a worker on a third store is killed by ``os._exit``
+   mid-append after one unit (torn final record, no done marker, a
+   lease left to expire); a second worker quarantines nothing (torn
+   tails drop), reclaims the dead worker's unit, re-runs it, and the
+   merged report STILL matches the solo bytes;
+4. the regression gate replays every stored bug bit-exactly inside each
+   later worker's startup (their JSON output carries the verdict).
+
+``--merged-only`` is the check_determinism.sh fleet leg: run the plan
+on a fresh store with N workers and write the merged report — the gate
+byte-diffs it across 2 driver processes x 2 worker counts.
+
+Exit code 0 = every assertion held. Stdout's last line is a JSON
+summary (machine-readable); progress goes to stderr.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# one pinned drill config (campaign seed 7 on purpose: its unit plan
+# spreads the two raft-amnesia fingerprints across the two halves of a
+# 4-unit plan — units 0-1 reach only n0, units 2-3 only n1 — which is
+# what makes the strictly-more-than-either-alone assertion meaningful)
+UNITS = 4
+CFG = dict(seeds_per_round=24, batch=2, chunk_size=24,
+           campaign_seed=7, max_recorded_seeds=4)
+TARGET_KW = dict(time_limit_ns=1_500_000_000, max_steps=15_000, hist_slots=0)
+SHRINK_TESTS = 24
+
+
+def _build():
+    from madsim_tpu.engine.faults import FaultSpec
+    from madsim_tpu.explore import CampaignConfig, amnesia_raft_target
+
+    target = amnesia_raft_target(**TARGET_KW)
+    base = FaultSpec(
+        crashes=3, crash_window_ns=1_200_000_000,
+        restart_lo_ns=50_000_000, restart_hi_ns=300_000_000,
+    )
+    return target, base, CampaignConfig(**CFG)
+
+
+def worker_main(args) -> None:
+    """One fleet worker process (the internal --worker mode)."""
+    from madsim_tpu.explore import CorpusStore, run_worker
+
+    target, base, ccfg = _build()
+    store = CorpusStore(args.store, worker=args.name, ttl_s=args.ttl)
+    res = run_worker(
+        target, base, ccfg, store, args.units,
+        max_units=args.max_units, shrink_tests=SHRINK_TESTS,
+        skip_gate=args.skip_gate,
+        _crash_after_units=args.crash_after,
+    )
+    reader = CorpusStore(args.store, worker=f"{args.name}-read")
+    _, stats = reader.read_records()
+    out = {
+        "worker": args.name,
+        "units": res["units"],
+        "fingerprints": res["fingerprints"],
+        "gate": res["gate"],
+        "stats": {
+            "lines": stats.lines,
+            "quarantined": stats.quarantined,
+            "truncated_logs": stats.truncated_logs,
+        },
+    }
+    print(json.dumps(out, sort_keys=True))
+
+
+def report_main(args) -> None:
+    """Write the merged fleet report (the internal --report-only mode —
+    import-only, no sweeps, so the drivers stay light)."""
+    from madsim_tpu.explore import CorpusStore, write_merged
+
+    write_merged(CorpusStore(args.store, worker="report"), args.report)
+
+
+def _spawn(store: str, name: str, *extra: str) -> subprocess.Popen:
+    cmd = [
+        sys.executable, os.path.abspath(__file__), "--worker",
+        "--store", store, "--name", name, "--units", str(UNITS), *extra,
+    ]
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    return subprocess.Popen(
+        cmd, stdout=subprocess.PIPE, stderr=sys.stderr, text=True, env=env
+    )
+
+
+def _run_worker_proc(store: str, name: str, *extra: str) -> dict:
+    p = _spawn(store, name, *extra)
+    out, _ = p.communicate(timeout=900)
+    if p.returncode != 0:
+        raise SystemExit(f"worker {name} failed rc={p.returncode}")
+    print(f"[fleet-smoke] worker {name} done", file=sys.stderr)
+    return json.loads(out.strip().splitlines()[-1])
+
+
+def _write_report(store: str, path: str) -> str:
+    subprocess.run(
+        [
+            sys.executable, os.path.abspath(__file__), "--report-only",
+            "--store", store, "--report", path,
+        ],
+        check=True, env=dict(os.environ, JAX_PLATFORMS="cpu"),
+    )
+    return open(path).read()
+
+
+def merged_only(args) -> None:
+    """The determinism-leg mode: N workers over a fresh store, merged
+    report to --report. Bytes must not depend on N (the gate diffs)."""
+    with tempfile.TemporaryDirectory() as d:
+        store = os.path.join(d, "store")
+        half = -(-UNITS // args.workers)
+        for i in range(args.workers):
+            extra = [] if i == args.workers - 1 else ["--max-units", str(half)]
+            _run_worker_proc(
+                store, f"w{i}", *extra, *(["--skip-gate"] if i else [])
+            )
+        _write_report(store, args.report)
+
+
+def drill(args) -> None:
+    outdir = args.outdir or tempfile.mkdtemp(prefix="fleet_smoke_")
+    os.makedirs(outdir, exist_ok=True)
+    summary: dict = {}
+
+    # leg 1: solo reference
+    s_solo = os.path.join(outdir, "solo")
+    solo = _run_worker_proc(s_solo, "solo")
+    ref = _write_report(s_solo, os.path.join(outdir, "merged_solo.jsonl"))
+    assert solo["units"] == list(range(UNITS)), solo
+    assert solo["fingerprints"], "solo run found no bugs; drill is vacuous"
+    summary["solo_fps"] = solo["fingerprints"]
+
+    # leg 2: two independent processes share one store; merged bytes
+    # identical to solo, fingerprint union strictly above either share
+    s_shared = os.path.join(outdir, "shared")
+    wa = _run_worker_proc(s_shared, "wa", "--max-units", str(UNITS // 2))
+    wb = _run_worker_proc(s_shared, "wb")
+    shared = _write_report(
+        s_shared, os.path.join(outdir, "merged_shared.jsonl")
+    )
+    assert shared == ref, "shared-store merged bytes diverged from solo"
+    merged_fps = sorted(
+        json.loads(ln)["key"] for ln in ref.splitlines()
+        if json.loads(ln).get("kind") == "bug"
+    )
+    assert len(merged_fps) > len(wa["fingerprints"]), (merged_fps, wa)
+    assert len(merged_fps) > len(wb["fingerprints"]), (merged_fps, wb)
+    assert set(wa["fingerprints"]) | set(wb["fingerprints"]) == set(merged_fps)
+    # worker B's startup gate replayed worker A's stored bugs bit-exactly
+    assert wa["gate"]["ok"] and wa["gate"]["checked"] == 0, wa["gate"]
+    assert wb["gate"]["ok"] and wb["gate"]["checked"] >= 1, wb["gate"]
+    summary["wa_fps"] = wa["fingerprints"]
+    summary["wb_fps"] = wb["fingerprints"]
+    summary["merged_fps"] = merged_fps
+    summary["gate_checked"] = wb["gate"]["checked"]
+
+    # leg 3: kill -9 mid-append + reclaim
+    s_kill = os.path.join(outdir, "kill")
+    p = _spawn(s_kill, "victim", "--crash-after", "1", "--ttl", "1")
+    p.communicate(timeout=900)
+    assert p.returncode == 137, f"victim exited {p.returncode}, wanted 137"
+    print("[fleet-smoke] victim killed mid-append", file=sys.stderr)
+    rec = _run_worker_proc(s_kill, "reclaimer", "--ttl", "1")
+    killed = _write_report(s_kill, os.path.join(outdir, "merged_kill.jsonl"))
+    assert killed == ref, "kill-and-reclaim merged bytes diverged from solo"
+    # the victim's torn final record was dropped, not quarantined, and
+    # its unleased units (everything it never finished) were re-run
+    assert rec["stats"]["truncated_logs"] >= 1, rec["stats"]
+    assert rec["stats"]["quarantined"] == 0, rec["stats"]
+    assert rec["gate"]["ok"], rec["gate"]
+    summary["reclaimer_units"] = rec["units"]
+    summary["reclaimer_gate"] = rec["gate"]
+
+    summary["merged_bytes"] = len(ref)
+    summary["ok"] = True
+    print(json.dumps(summary, sort_keys=True))
+    print(f"[fleet-smoke] OK ({outdir})", file=sys.stderr)
+
+
+def main() -> None:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--worker", action="store_true")
+    ap.add_argument("--report-only", action="store_true")
+    ap.add_argument("--merged-only", action="store_true")
+    ap.add_argument("--store", type=str)
+    ap.add_argument("--name", type=str, default=None)
+    ap.add_argument("--units", type=int, default=UNITS)
+    ap.add_argument("--max-units", type=int, default=None)
+    ap.add_argument("--crash-after", type=int, default=None)
+    ap.add_argument("--ttl", type=float, default=30.0)
+    ap.add_argument("--skip-gate", action="store_true")
+    ap.add_argument("--workers", type=int, default=1)
+    ap.add_argument("--report", type=str, default=None)
+    ap.add_argument("--outdir", type=str, default=None)
+    args = ap.parse_args()
+    if args.worker:
+        worker_main(args)
+    elif args.report_only:
+        report_main(args)
+    elif args.merged_only:
+        merged_only(args)
+    else:
+        drill(args)
+
+
+if __name__ == "__main__":
+    main()

@@ -22,3 +22,12 @@ _spec.loader.exec_module(_mod)
 
 _mod.force_cpu_mesh_env(os.environ, 8)
 _mod.apply_in_process()
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: heavyweight end-to-end tests excluded from the tier-1 "
+        "budgeted run (-m 'not slow'); `make test`/`make stest` and the "
+        "matching smoke gates still cover them",
+    )

@@ -133,6 +133,41 @@ def test_journal_roundtrip(tmp_path):
             json.loads(line)
 
 
+def test_journal_torn_final_line_every_offset(tmp_path):
+    # the crash-recovery contract: a writer killed mid-append leaves a
+    # torn partial FINAL line; read_journal must return the valid prefix
+    # with .truncated set — at EVERY byte offset of the last record
+    path = str(tmp_path / "run.jsonl")
+    j = obs.Journal(path, run_id="dead" * 4)
+    j.write("chunk", lo=0, k=32)
+    j.write("flush", lo=0, wall_s=0.25, note="padding so the torn line "
+            "has structure worth truncating through")
+    j.close()
+    data = open(path, "rb").read()
+    last_start = data.rstrip(b"\n").rfind(b"\n") + 1
+    last_len = len(data) - last_start  # includes the trailing newline
+    whole = obs.read_journal(path)
+    assert not whole.truncated and len(whole) == 4
+    for off in range(last_len + 1):
+        with open(path, "wb") as f:
+            f.write(data[: last_start + off])
+        recs = obs.read_journal(path)
+        if off in (0, last_len - 1, last_len):
+            # clean cuts: the record absent, or complete (a cut that
+            # drops only the trailing newline still parses whole)
+            assert not recs.truncated
+            assert len(recs) == (3 if off == 0 else 4)
+        else:
+            assert recs.truncated, f"offset {off} not flagged"
+            assert recs == whole[:3]
+    # a malformed line with more data AFTER it is corruption, not a torn
+    # tail — that still raises
+    with open(path, "wb") as f:
+        f.write(data[: last_start + 5] + b"\n" + data[last_start:])
+    with pytest.raises(json.JSONDecodeError):
+        obs.read_journal(path)
+
+
 def test_new_run_id_unique_hex():
     ids = {obs.new_run_id() for _ in range(64)}
     assert len(ids) == 64

@@ -151,3 +151,51 @@ def test_canonical_bytes_dedup_key():
     c = hist(3, 1_000)
     c = c._replace(ops=(c.ops[0]._replace(out=8),) + c.ops[1:])
     assert history_canonical_bytes(c) != history_canonical_bytes(a)
+
+
+def test_feed_segments_bit_identical_to_one_shot():
+    # the fleet feed hook: a stream fed its queue in segments mid-flight
+    # produces the same report bytes as the one-shot queue (and thus as
+    # the chunked driver, by the stream contract)
+    wl, ecfg, summarize = _cases()[0]
+    seeds = jnp.arange(_SEEDS, dtype=jnp.int64)
+    one = stream_sweep(
+        wl, ecfg, seeds, summarize, chunk_size=8, pool_size=8,
+        round_steps=128,
+    )
+    segs = [np.arange(8, 16, dtype=np.int64), np.arange(16, 24, dtype=np.int64)]
+    fed = stream_sweep(
+        wl, ecfg, jnp.arange(8, dtype=jnp.int64), summarize,
+        chunk_size=8, pool_size=8, round_steps=128,
+        feed=lambda: {"seeds": segs.pop(0)} if segs else None,
+    )
+    assert fed == one
+    assert not segs  # both segments were actually consumed
+
+
+def test_feed_guards():
+    import pytest
+
+    wl, ecfg, summarize = _cases()[0]
+    seeds = jnp.arange(8, dtype=jnp.int64)
+    nothing = lambda: None  # noqa: E731
+    with pytest.raises(ValueError, match="queue_order"):
+        stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8, feed=nothing,
+            queue_order=np.arange(8)[::-1],
+        )
+    with pytest.raises(ValueError, match="checkpointing"):
+        stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8, feed=nothing,
+            ckpt_path="/tmp/nope.npz", stop_after_rounds=1,
+        )
+    with pytest.raises(ValueError, match="multiple of"):
+        stream_sweep(
+            wl, ecfg, jnp.arange(7, dtype=jnp.int64), summarize,
+            chunk_size=8, feed=nothing,
+        )
+    with pytest.raises(ValueError, match="multiple of"):
+        stream_sweep(
+            wl, ecfg, seeds, summarize, chunk_size=8,
+            feed=iter([{"seeds": np.arange(8, 11, dtype=np.int64)}]).__next__,
+        )
