@@ -84,9 +84,13 @@ explore-smoke:
 
 # the history-oracle pipeline end to end (docs/oracle.md): seeded etcd
 # stale-read bug -> WGL checker rejects -> history-flavor triage ->
-# checker-verified shrink -> sweep/traced byte identity -> clean control
+# checker-verified shrink -> sweep/traced byte identity -> clean control;
+# then the checked sweep once more through the on-device decode kernel
+# (docs/oracle.md "Device-side checking")
 oracle-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/oracle_demo.py
+	JAX_PLATFORMS=cpu $(PY) scripts/checked_sweep_demo.py --seeds 96 \
+		--chunk-size 32 --device-decode --report /dev/null
 
 # host<->device differential gate (docs/faults.md "Gray failures"): a
 # 200-seed matched-(spec, seed) grid per fault family — crash storm +

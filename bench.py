@@ -75,6 +75,7 @@ PARITY_SEEDS = 4096
 CHECKED_TOTAL = 131072
 CHECKED_CHUNK = None  # None = auto-pick the occupancy knee
 CHECKED_SIM_SECONDS = 2.0  # hist_slots=256 is sized for a 2 s horizon
+CHECKED_REPS = 2  # interleaved checked/unchecked reps (full-scale leg)
 NAIVE_SEEDS = 4096
 CHECK_WORKERS = 8
 # pipelined-recovery leg: 2 chunks, interrupted mid-chunk-0
@@ -304,11 +305,18 @@ def bench_checked_sweep() -> dict:
     hist_slots=256) at CHECKED_TOTAL seeds through
     ``oracle.screen.checked_sweep``: chunked sweep, on-device suspect
     screen folded behind each chunk, host-side decode + process-pool
-    WGL checking of chunk N overlapped with the device sweep of chunk
-    N+1. The naive baseline — sweep, decode EVERY lane, check serially,
-    no overlap — is measured in the same run (on a smaller seed count;
-    rates compare directly since both are per-seed-linear)."""
+    WGL checking of chunk N interleaved with the device rounds of chunk
+    N+1 (budgeted incremental polling). Its UNCHECKED TWIN — the same
+    pipelined sweep + summary with no screen, no decode, no checker —
+    runs in the same process at the same seed count, interleaved
+    rep-outer/case-inner so load drift hits both legs alike; the ratio
+    ``checked_over_unchecked`` is the full price of history validation
+    (acceptance: <= 2x at this scale on CPU). The naive baseline —
+    sweep, decode EVERY lane, check serially, no overlap — is measured
+    on a smaller seed count; rates compare directly since both are
+    per-seed-linear."""
     from madsim_tpu.engine import core
+    from madsim_tpu.engine.checkpoint import run_sweep_pipelined
     from madsim_tpu.models import etcd
     from madsim_tpu.oracle import check_histories, decode_sweep
     from madsim_tpu.oracle.screen import checked_sweep
@@ -322,23 +330,37 @@ def bench_checked_sweep() -> dict:
     chunk = CHECKED_CHUNK or core.pick_chunk_size(wl, ecfg)
     total = max(CHECKED_TOTAL, 2 * chunk)
 
-    # warm every program untimed — BOTH legs: the pipeline's sweep/
-    # screen/summary/pool at the chunk shape, AND the naive leg's sweep
-    # at NAIVE_SEEDS (a compile inside nwall would hand the pipeline a
-    # fake speedup) plus one decode+check rep
+    # warm every program untimed — ALL legs: the pipeline's sweep/
+    # screen/summary/pool at the chunk shape, the unchecked twin
+    # (shares the sweep/summary programs — run once anyway so its
+    # driver path holds no first-call surprises), AND the naive leg's
+    # sweep at NAIVE_SEEDS (a compile inside nwall would hand the
+    # pipeline a fake speedup) plus one decode+check rep
     checked_sweep(
         wl, ecfg, _fresh(chunk), spec, etcd.sweep_summary,
         chunk_size=chunk, workers=CHECK_WORKERS,
     )
+    run_sweep_pipelined(
+        wl, ecfg, _fresh(chunk), etcd.sweep_summary, chunk_size=chunk
+    )
     warm_naive = core.run_sweep(wl, ecfg, _fresh(NAIVE_SEEDS))
     check_histories(decode_sweep(warm_naive), spec)
 
-    t0 = walltime.perf_counter()
-    totals = checked_sweep(
-        wl, ecfg, _fresh(total), spec, etcd.sweep_summary,
-        chunk_size=chunk, workers=CHECK_WORKERS,
-    )
-    wall = walltime.perf_counter() - t0
+    cwalls, uwalls = [], []
+    totals = None
+    for _rep in range(CHECKED_REPS):
+        t0 = walltime.perf_counter()
+        totals = checked_sweep(
+            wl, ecfg, _fresh(total), spec, etcd.sweep_summary,
+            chunk_size=chunk, workers=CHECK_WORKERS,
+        )
+        cwalls.append(walltime.perf_counter() - t0)
+        t0 = walltime.perf_counter()
+        run_sweep_pipelined(
+            wl, ecfg, _fresh(total), etcd.sweep_summary, chunk_size=chunk
+        )
+        uwalls.append(walltime.perf_counter() - t0)
+    wall, uwall = min(cwalls), min(uwalls)
 
     t0 = walltime.perf_counter()
     nfinal = core.run_sweep(wl, ecfg, _fresh(NAIVE_SEEDS))
@@ -348,16 +370,26 @@ def bench_checked_sweep() -> dict:
     )
     nwall = walltime.perf_counter() - t0
 
-    rate, nrate = total / wall, NAIVE_SEEDS / nwall
+    rate, urate, nrate = total / wall, total / uwall, NAIVE_SEEDS / nwall
     return {
         "seeds": total,
         "chunk_size": chunk,
         "workers": CHECK_WORKERS,
+        "reps": CHECKED_REPS,
         "wall_s": round(wall, 2),
         "seeds_per_sec": round(rate, 1),
+        "spread": _spread(cwalls),
         "suspects": totals["hist_suspects"],
         "hist_violations": totals["hist_violations"],
         "hist_overflow_seeds": totals["hist_overflow_seeds"],
+        "budget_exceeded": totals.get("budget_exceeded", 0),
+        "unchecked": {
+            "seeds": total,
+            "wall_s": round(uwall, 2),
+            "seeds_per_sec": round(urate, 1),
+            "spread": _spread(uwalls),
+        },
+        "checked_over_unchecked": round(wall / uwall, 2),
         "naive": {
             "seeds": NAIVE_SEEDS,
             "wall_s": round(nwall, 2),
@@ -957,7 +989,7 @@ def _smoke() -> None:
     and the JSON shape are the point."""
     global CURVE, BIG_TOTAL, BIG_CHUNK, HOST_SEEDS, REPS, SIM_SECONDS
     global PARITY_SEEDS, CHECKED_TOTAL, CHECKED_CHUNK, CHECKED_SIM_SECONDS
-    global NAIVE_SEEDS, CHECK_WORKERS, PIPE_SEEDS, PIPE_CHUNK
+    global CHECKED_REPS, NAIVE_SEEDS, CHECK_WORKERS, PIPE_SEEDS, PIPE_CHUNK
     global CAMPAIGN_K, CAMPAIGN_SEEDS, CAMPAIGN_REPS, CAMPAIGN_SIM_SECONDS
     global STREAM_CURVE, STREAM_CHUNK, STREAM_POOL, STREAM_REPS
     global STREAM_SIM_SECONDS, STREAM_ROUND_STEPS, STREAM_MAX_STEPS
@@ -975,6 +1007,7 @@ def _smoke() -> None:
     CHECKED_TOTAL = 256
     CHECKED_CHUNK = 128
     CHECKED_SIM_SECONDS = 0.5
+    CHECKED_REPS = 1
     NAIVE_SEEDS = 64
     CHECK_WORKERS = 2
     PIPE_SEEDS = 128
@@ -1011,6 +1044,11 @@ if __name__ == "__main__":
         # the telemetry-overhead leg standalone (the ≤3% gate on the
         # streaming checked-sweep path)
         print(json.dumps({"metric": "telemetry_leg", **bench_telemetry()}))
+    elif "--checked" in sys.argv:
+        # the checked-sweep leg standalone (checked vs its same-run
+        # unchecked twin; the <=2x checked_over_unchecked acceptance
+        # figure at CHECKED_TOTAL seeds)
+        print(json.dumps({"metric": "checked_leg", **bench_checked_sweep()}))
     elif "--carryover" in sys.argv:
         # the flagged-legs re-run (kafka/etcd spread gate + auto_chunk
         # curve point) for the per-round BENCH_rNN.json record

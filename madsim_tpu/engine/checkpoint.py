@@ -531,6 +531,17 @@ def run_sweep_pipelined(
     oracle's screened checker is), so the merged totals are byte-stable
     across pipelining, worker-pool sizes, and interruption points.
 
+    A ``host_work`` advertising ``incremental = True`` (the oracle's
+    ``history_host_work`` does) is driven through its
+    ``submit``/``poll``/``drain`` protocol instead of being run to
+    completion inside each overlap window: each chunk's checking is
+    sliced under a budget tracking the device phase's EMA wall time, so
+    one contended chunk's WGL work spreads across later chunks' device
+    time rather than stalling dispatch. Disabled (sync fallback) under
+    ``ckpt_dir``/``stop_after``/``resume_from``, whose chunk files need
+    summaries finalized at their own boundaries. Byte-identical totals
+    either way — the budget shapes scheduling, never verdict order.
+
     Scale-out hooks (``parallel.mesh.run_sweep_sharded_pipelined`` is
     the canonical injector): ``run_chunk(seed_chunk) -> final`` replaces
     the per-chunk sweep and ``resume_chunk(state) -> final`` the
@@ -595,6 +606,65 @@ def run_sweep_pipelined(
     totals: dict = {}
     pending = None  # previous chunk awaiting its host phase
     computed = 0
+
+    # budgeted incremental checking: a host_work advertising the
+    # submit/poll/drain protocol (oracle.screen._HostWork) gets its WGL
+    # work sliced under a per-chunk budget — the device phase's own EMA
+    # wall time — instead of run to completion inside each overlap
+    # window, so one expensive chunk's checking spreads across later
+    # chunks' device time instead of stalling the dispatch loop. OFF
+    # under checkpointing/stop/resume: those need each chunk's summary
+    # finalized at its own boundary (the chunk file IS the resume
+    # granule). Reports are byte-identical either way: verdicts are
+    # computed and merged in submission (= seed) order regardless of
+    # how the budget slices them.
+    incr = (
+        host_work is not None
+        and getattr(host_work, "incremental", False)
+        and ckpt_dir is None
+        and stop_after is None
+        and resume_from is None
+    )
+    deferred: dict = {}  # lo -> (k, base summary) awaiting a verdict
+    ema = 0.0
+
+    def absorb(finished) -> None:
+        for flo, extra in finished:
+            fk, summary = deferred.pop(flo)
+            if extra:
+                summary = {**summary, **extra}
+            merge_summaries(totals, summary)
+            if telemetry is not None:
+                telemetry.count("sweep_chunks_total")
+                telemetry.count(
+                    "sweep_seeds_done_total", fk,
+                    help="seeds merged so far",
+                )
+                telemetry.event_mix(summary)
+                telemetry.event("chunk", lo=flo, k=fk)
+            if on_chunk is not None:
+                on_chunk(lo=flo, k=fk, summary=summary)
+
+    def submit_pending(p, budget: float) -> None:
+        lo, k, _sha, final, susp, summary, _path = p
+        if telemetry is not None:
+            t_host = _time.perf_counter()
+        deferred[lo] = (k, summary)
+        host_work.submit(
+            final,
+            lo=lo,
+            n=k,
+            seeds=seeds_host[lo : lo + k],
+            suspect=None if susp is None else np.asarray(susp)[:k],
+            summary=summary,
+        )
+        absorb(host_work.poll(budget))
+        if telemetry is not None:
+            telemetry.observe(
+                "sweep_host_phase_seconds",
+                _time.perf_counter() - t_host,
+                help="host phase (decode/check/ckpt write) per chunk",
+            )
 
     def flush(p) -> None:
         lo, k, sha, final, susp, summary, path = p
@@ -665,8 +735,9 @@ def run_sweep_pipelined(
             continue
 
         # -- device phase: enqueue this chunk's sweep (+ screen) --------
-        if telemetry is not None:
+        if telemetry is not None or incr:
             t_disp = _time.perf_counter()
+        if telemetry is not None:
             d0 = tracer._now_us() if tracer is not None else 0.0
         pad = chunk_size - k if n > chunk_size else -k % pad_multiple
         if lo == resume_lo:
@@ -705,7 +776,10 @@ def run_sweep_pipelined(
 
         # -- previous chunk's host phase overlaps this chunk's sweep ----
         if pending is not None:
-            flush(pending)
+            if incr:
+                submit_pending(pending, ema)
+            else:
+                flush(pending)
             pending = None
 
         # -- this chunk's summary (blocks until its sweep completes) ----
@@ -721,11 +795,15 @@ def run_sweep_pipelined(
             final = _concat_finals(k, final)
         if susp is not None and pad:
             susp = susp[:k]
-        if telemetry is not None:
+        if telemetry is not None or incr:
             # summarize() above synced on the device work, so this wall
             # window (dispatch -> summary materialized) IS the device
-            # phase; the previous chunk's host flush ran inside it
+            # phase; the previous chunk's host flush ran inside it —
+            # and its EMA is the incremental checker's poll budget (the
+            # checking a chunk's device time can hide)
             dt = _time.perf_counter() - t_disp
+            ema = dt if ema == 0.0 else 0.5 * ema + 0.5 * dt
+        if telemetry is not None:
             telemetry.observe(
                 "sweep_chunk_seconds", dt,
                 help="device phase (dispatch -> summary) per chunk",
@@ -741,7 +819,12 @@ def run_sweep_pipelined(
             break
 
     if pending is not None:
-        flush(pending)
+        if incr:
+            submit_pending(pending, 0.0)
+        else:
+            flush(pending)
+    if incr:
+        absorb(host_work.drain())
     return totals
 
 

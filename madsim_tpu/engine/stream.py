@@ -408,6 +408,29 @@ def stream_sweep(
         return shard_params(mesh, tree)
 
     totals: dict = {}
+    # budgeted incremental checking: a host_work advertising the
+    # submit/poll/drain protocol (oracle.screen._HostWork) has its WGL
+    # work interleaved with the DEVICE rounds — each flush submits its
+    # chunk (cheap decode+dedup) and the verdict work is polled right
+    # after every round's dispatch, inside the window where the device
+    # is crunching and the host would otherwise just block on
+    # state.done. The poll budget tracks the round wall time's EMA
+    # (minus the poll's own cost), so checking consumes exactly the
+    # host idle the rounds create and the pool never stalls on the
+    # checker. OFF under checkpointing (ckpt_path/stop_after_rounds/
+    # resume_from): snapshots need every flushed chunk's summary
+    # finalized at its flush. Reports are byte-identical either way —
+    # chunks finalize and merge strictly in submission order no matter
+    # how the budget slices the checking.
+    incr = (
+        host_work is not None
+        and getattr(host_work, "incremental", False)
+        and ckpt_path is None
+        and stop_after_rounds is None
+        and resume_from is None
+    )
+    deferred: dict = {}  # lo -> (k, base summary) awaiting a verdict
+    round_ema = 0.0
     # captured-but-unflushed results live in per-chunk host buffers
     # (one preallocated [k_c, ...] array per leaf — captures and flushes
     # are vectorized scatters/reads, never per-row python loops)
@@ -545,6 +568,27 @@ def stream_sweep(
                 occupancy_mean=(occ_sum / rounds if rounds else 0.0),
             )
 
+    def absorb(finished):
+        """Merge finished incremental reports — ``(lo, extra)`` pairs
+        in submission order, the only order ``_HostWork.poll`` ever
+        returns them in, so the totals merge exactly as the sync path's
+        would."""
+        for flo, extra in finished:
+            fk, summary = deferred.pop(flo)
+            if extra:
+                summary = {**summary, **extra}
+            merge_summaries(totals, summary)
+            if telemetry is not None:
+                telemetry.count(
+                    "stream_seeds_done_total", fk,
+                    help="seeds flushed into the merged report",
+                )
+                telemetry.event_mix(summary)
+                telemetry.event("flush", lo=flo, k=fk)
+            if on_chunk is not None:
+                on_chunk(lo=flo, k=fk, summary=summary)
+            publish_stats()
+
     def flush_ready():
         nonlocal next_flush_lo
         while next_flush_lo < n:
@@ -559,6 +603,33 @@ def stream_sweep(
             pend_have.pop(c)
             sus = sus_buf.pop(c)
             summary = summarize(chunk_state)
+            if incr:
+                # defer the verdict: submit runs decode+dedup now, the
+                # WGL slices run from the per-round polls, and absorb()
+                # merges when the chunk's report is final
+                host_work.submit(
+                    chunk_state,
+                    lo=next_flush_lo,
+                    n=k,
+                    seeds=seeds_host[next_flush_lo : next_flush_lo + k],
+                    suspect=None if screen is None else sus,
+                    summary=summary,
+                )
+                deferred[next_flush_lo] = (k, summary)
+                if telemetry is not None:
+                    dt = _time.perf_counter() - t_flush
+                    telemetry.observe(
+                        "stream_flush_seconds", dt,
+                        help="virtual-chunk flush (summary+host work)",
+                    )
+                    if tracer is not None:
+                        tracer.complete(
+                            f"flush lo={next_flush_lo}", f0,
+                            tracer._now_us() - f0, track="host",
+                            args={"lo": next_flush_lo, "k": k},
+                        )
+                next_flush_lo += k
+                continue
             if host_work is not None:
                 extra = host_work(
                     chunk_state,
@@ -763,6 +834,8 @@ def stream_sweep(
         )
         budget_dev = jnp.asarray(lane_budget)
         stop_dev = jnp.asarray([stop], jnp.int32)
+        if incr:
+            t_disp = _time.perf_counter()
         if mesh is None:
             state = _round(
                 workload, cfg, round_steps, state, budget_dev, stop_dev[0]
@@ -774,7 +847,20 @@ def stream_sweep(
         rounds += 1
         rounds_this_call += 1
 
+        if incr:
+            # the round program is dispatched but not synced: this is
+            # the host's idle window, so burn it on deferred WGL work
+            # under the round-time EMA budget (its own cost excluded —
+            # the feedback otherwise inflates the budget it measures)
+            t_poll = _time.perf_counter()
+            absorb(host_work.poll(round_ema))
+            poll_s = _time.perf_counter() - t_poll
         done = np.asarray(state.done)  # syncs on the round program
+        if incr:
+            dt = max(0.0, _time.perf_counter() - t_disp - poll_s)
+            round_ema = dt if round_ema == 0.0 else (
+                0.5 * round_ema + 0.5 * dt
+            )
         if telemetry is not None:
             telemetry.observe(
                 "stream_round_seconds", _time.perf_counter() - t_round,
@@ -862,6 +948,12 @@ def stream_sweep(
                 },
             )
             break
+
+    if incr:
+        # settle any WGL work still pending after the last flush so the
+        # returned totals are complete (drain preserves submission order,
+        # so the merged summary is byte-for-byte the sync path's).
+        absorb(host_work.drain())
 
     publish_stats()
     return totals

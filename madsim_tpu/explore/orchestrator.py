@@ -176,12 +176,13 @@ def run_worker(
 
     def host_work(final, *, lo, n, seeds, suspect, summary) -> dict:
         del lo, n, seeds
+        cstats: dict = {}
         if suspect is not None:
             from ..oracle.check import violating_seeds
 
             vio = violating_seeds(
                 final, target.hist_spec, screen=lambda _f: suspect,
-                workers=ccfg.check_workers,
+                workers=ccfg.check_workers, stats=cstats,
             )
         else:
             vio = np.asarray(target.violating(final))
@@ -190,6 +191,17 @@ def run_worker(
         }
         if "violations" not in summary:
             out["violations"] = int(vio.size)
+        # honest-verdict bookkeeping: lanes whose WGL search ran out of
+        # state budget count as non-violating above, so the unit summary
+        # carries the count (merge_summaries sums it across chunks)
+        if cstats.get("budget_exceeded"):
+            out["budget_exceeded"] = int(cstats["budget_exceeded"])
+            if telemetry is not None:
+                telemetry.count(
+                    "oracle_budget_exceeded_total",
+                    int(cstats["budget_exceeded"]),
+                    help="WGL verdicts undecided at max_states",
+                )
         return out
 
     fed: List[Tuple[int, List[object]]] = []  # feed order: (unit, specs)

@@ -259,6 +259,7 @@ def violating_seeds(
     max_states: int = 200_000,
     screen=None,
     workers: int = 0,
+    stats: Optional[dict] = None,
 ) -> np.ndarray:
     """Seeds of a finished sweep whose decoded history the checker
     rejects — the history oracle's counterpart of
@@ -273,7 +274,14 @@ def violating_seeds(
     the same but quietly degrades to checking every lane for unscreened
     specs; a callable screens with ``screen(final) -> bool[S]``.
     ``workers`` fans the checker over a process pool
-    (``check_histories``)."""
+    (``check_histories``).
+
+    ``stats`` (a dict, mutated in place) receives
+    ``{"checked": lanes handed to the checker, "budget_exceeded":
+    lanes whose WGL search exhausted max_states}`` — undecided lanes
+    are reported as non-violating (the checker is sound, not complete
+    under a finite budget), so callers wanting the honest picture
+    surface this count next to the seed list."""
     from .history import decode_lanes, decode_sweep
 
     if screen == "auto":
@@ -294,5 +302,10 @@ def violating_seeds(
     results = check_histories(
         hists, spec, max_states=max_states, workers=workers
     )
+    if stats is not None:
+        stats["checked"] = len(hists)
+        stats["budget_exceeded"] = sum(
+            1 for r in results if not r.decided
+        )
     out = [h.seed for h, r in zip(hists, results) if not r.ok]
     return np.asarray(out, dtype=np.int64)

@@ -207,6 +207,59 @@ def history_bytes(hist: History) -> bytes:
     return ("\n".join(lines) + "\n").encode()
 
 
+_CANON_MAGIC = b"MTHC1\n"  # canonical-row format tag (docs/oracle.md)
+_CANON_COLS = 8  # (client, op, key, inp, out, rank_inv, rank_comp, opid)
+
+
+def canonical_bytes_from_rows(rows, n_ops, raw_rows, overflow) -> bytes:
+    """Assemble the canonical byte encoding from fixed-width rows.
+
+    ``rows`` is ``int32[*, 8]`` in invoke order — columns ``(client,
+    op, key, inp, out-or-0-while-open, invoke rank, complete rank or
+    -1, opid)`` — of which the first ``n_ops`` are encoded after a
+    magic tag and an ``(raw_rows, overflow)`` int32 header, all
+    little-endian. Both producers — the host path
+    (``history_canonical_bytes``) and the device kernel
+    (``canon_sweep``) — funnel through here, so their byte-identity
+    contract reduces to row-array equality."""
+    n = int(n_ops)
+    head = np.asarray([int(raw_rows), int(bool(overflow))], dtype="<i4")
+    body = np.ascontiguousarray(
+        np.asarray(rows, dtype=np.int32)[:n], dtype="<i4"
+    )
+    return _CANON_MAGIC + head.tobytes() + body.tobytes()
+
+
+def canonical_rows(hist: History) -> np.ndarray:
+    """Host-side canonical rows (``int32[n_ops, 8]``) of a decoded
+    history: each op's fields with its times replaced by their dense
+    rank over the history's distinct valid times (open completions stay
+    ``-1``; an open op's ``out`` is pinned to 0, which is what
+    ``_pair_rows`` stores for a never-completed op anyway)."""
+    ts = sorted(
+        {
+            t
+            for o in hist.ops
+            for t in (o.invoke_ns, o.complete_ns)
+            if t >= 0
+        }
+    )
+    rank = {t: i for i, t in enumerate(ts)}
+    return np.asarray(
+        [
+            (
+                o.client, o.op, o.key, o.inp,
+                o.out if o.complete else 0,
+                rank[o.invoke_ns],
+                rank[o.complete_ns] if o.complete else -1,
+                o.opid,
+            )
+            for o in hist.ops
+        ],
+        dtype=np.int32,
+    ).reshape(len(hist.ops), _CANON_COLS)
+
+
 def history_canonical_bytes(hist: History) -> bytes:
     """Seed-free, time-rank canonical encoding — the dedup key for WGL
     checking (oracle/screen.history_host_work).
@@ -218,27 +271,148 @@ def history_canonical_bytes(hist: History) -> bytes:
     comparisons, so replacing each distinct time by its dense rank is an
     order-isomorphism that preserves the checker's verdict exactly —
     one representative verdict is valid for the whole equivalence class.
-    Open ops keep their ``-1`` completion sentinel. Unlike
-    ``history_bytes`` this is NOT the determinism-gate encoding: it
-    deliberately erases the seed and the absolute clock."""
-    ts = sorted(
-        {
-            t
-            for o in hist.ops
-            for t in (o.invoke_ns, o.complete_ns)
-            if t >= 0
-        }
+    Open ops keep their ``-1`` completion sentinel. The encoding is the
+    fixed-width binary of ``canonical_bytes_from_rows`` so the on-device
+    decode kernel (``canon_sweep``) can produce it without any host-side
+    re-derivation. Unlike ``history_bytes`` this is NOT the
+    determinism-gate encoding: it deliberately erases the seed and the
+    absolute clock."""
+    return canonical_bytes_from_rows(
+        canonical_rows(hist), len(hist.ops), hist.rows, hist.overflow
     )
-    rank = {t: i for i, t in enumerate(ts)}
-    lines = [f"rows={hist.rows} overflow={int(hist.overflow)}"]
-    lines += [
-        f"c={o.client} op={OP_NAMES[o.op]} key={o.key} in={o.inp} "
-        f"out={o.out if o.complete else '?'} "
-        f"t=[{rank[o.invoke_ns]},{rank[o.complete_ns] if o.complete else -1}]"
-        f" id={o.opid}"
-        for o in hist.ops
-    ]
-    return ("\n".join(lines) + "\n").encode()
+
+
+def history_from_canon(
+    rows, n_ops, overflow, raw_rows, seed: int = -1
+) -> History:
+    """Rebuild a checkable ``History`` from canonical fixed-width rows,
+    using each op's dense time ranks AS its times. Ranks are an
+    order-isomorphism of the original clock, and the WGL checker and
+    every structural pre-pass read times only through comparisons, so
+    the verdict on the rebuilt history equals the verdict on the
+    host-decoded one — the device-decode path checks THIS history and
+    no report byte can tell the difference."""
+    n = int(n_ops)
+    r = np.asarray(rows)
+    ops = tuple(
+        Op(
+            client=int(r[i, 0]), op=int(r[i, 1]), key=int(r[i, 2]),
+            inp=int(r[i, 3]), out=int(r[i, 4]),
+            invoke_ns=int(r[i, 5]), complete_ns=int(r[i, 6]),
+            opid=int(r[i, 7]),
+        )
+        for i in range(n)
+    )
+    return History(
+        seed=int(seed), ops=ops, overflow=bool(overflow),
+        rows=int(raw_rows),
+    )
+
+
+_CANON_KERNEL = None
+
+
+def _canon_kernel():
+    """Build (once) the jitted, vmapped per-lane canonical-decode
+    kernel. jax is imported lazily so the checker's pool workers —
+    clean interpreters that import this module (oracle/check.py) —
+    stay numpy-only."""
+    global _CANON_KERNEL
+    if _CANON_KERNEL is None:
+        import jax
+        import jax.numpy as jnp
+
+        def lane(rec, t, n):
+            H = rec.shape[0]
+            idx = jnp.arange(H, dtype=jnp.int32)
+            valid = idx < n
+            client, code, key, val, opid = (rec[:, c] for c in range(5))
+            op, ph = code // 2, code % 2
+            inv = valid & (ph == PH_INVOKE)
+            okm = valid & (ph == PH_OK)
+            # pairing: an ok row k matches the LATEST invoke row i of
+            # the same (client, opid) with prev_ok(k) < i < k, where
+            # prev_ok(k) is k's latest earlier ok sibling — exactly the
+            # overwrite-on-reinvoke / pop-on-ok dict semantics of
+            # ``_pair_rows``. No match (or an op/key mismatch against
+            # the matched invoke) is the record-hook contract breach
+            # ``_pair_rows`` raises on; the kernel can't raise, so it
+            # flags the lane and the caller falls back to the host
+            # decoder for the real error
+            same = (client[:, None] == client[None, :]) & (
+                opid[:, None] == opid[None, :]
+            )
+            earlier = idx[None, :] < idx[:, None]
+            neg = jnp.int32(-1)
+            prev_ok = jnp.max(
+                jnp.where(same & earlier & okm[None, :], idx[None, :], neg),
+                axis=1,
+            )
+            cand = (
+                same
+                & earlier
+                & inv[None, :]
+                & (idx[None, :] > prev_ok[:, None])
+            )
+            match = jnp.max(jnp.where(cand, idx[None, :], neg), axis=1)
+            m = jnp.clip(match, 0, H - 1)
+            mism = (op[m] != op) | (key[m] != key)
+            breach = jnp.any(okm & ((match < 0) | mism))
+            # dense time rank: a row's rank = number of distinct valid
+            # times strictly below its own. Exact under ties (only the
+            # first row of a tie group counts as distinct); device
+            # lanes have strictly increasing t so rank == row index,
+            # but host-recorded planes may tie
+            first = valid & ~jnp.any(
+                (t[None, :] == t[:, None]) & earlier & valid[None, :],
+                axis=1,
+            )
+            rank = jnp.sum(
+                first[None, :] & (t[None, :] < t[:, None]), axis=1
+            ).astype(jnp.int32)
+            # assembly: invoke k is op number slot[k]; scatter invoke
+            # rows whole, then patch (out, rank_comp) at the matched
+            # slots — targets are disjoint (two ok rows can't match one
+            # invoke: the second's prev_ok bound excludes it). Masked
+            # rows scatter into the extra row H, sliced off
+            slot = jnp.cumsum(inv.astype(jnp.int32)) - 1
+            n_ops = jnp.sum(inv.astype(jnp.int32))
+            dump = jnp.int32(H)
+            inv_rows = jnp.stack(
+                [
+                    client, op, key, val,
+                    jnp.zeros_like(val), rank,
+                    jnp.full_like(val, -1), opid,
+                ],
+                axis=1,
+            ).astype(jnp.int32)
+            canon = jnp.zeros((H + 1, _CANON_COLS), dtype=jnp.int32)
+            canon = canon.at[jnp.where(inv, slot, dump)].set(inv_rows)
+            ok_tgt = jnp.where(okm & (match >= 0), slot[m], dump)
+            canon = canon.at[ok_tgt, 4].set(val)
+            canon = canon.at[ok_tgt, 6].set(rank)
+            return canon[:H], n_ops, breach
+
+        _CANON_KERNEL = jax.jit(jax.vmap(lane))
+    return _CANON_KERNEL
+
+
+def canon_sweep(final):
+    """On-device canonical decode of EVERY lane of a finished sweep
+    state: ``(canon int32[S, H, 8], n_ops int32[S], breach bool[S])``.
+
+    ``canon[s, :n_ops[s]]`` are lane ``s``'s canonical rows — the same
+    rows ``canonical_rows(decode_seed(final, s))`` derives on the host,
+    by the pairing/rank arguments in ``_canon_kernel`` — so
+    ``canonical_bytes_from_rows`` over a device row block equals
+    ``history_canonical_bytes`` over the host-decoded lane bit-exactly.
+    One fixed-shape jitted call covers the whole chunk (no per-lane
+    recompiles); callers gather just the lanes they need off the device
+    afterwards. ``breach[s]`` marks a record-hook contract breach
+    (orphan ok / op-key mismatch) on lane ``s``: those rows are
+    unusable and the caller must route that lane through the host
+    decoder, which raises the diagnostic."""
+    return _canon_kernel()(final.hist_rec, final.hist_t, final.hist_len)
 
 
 class HostRecorder:

@@ -14,16 +14,16 @@ seeds the full checker would reject, so skipping the clean lanes never
 hides a violation. Each screen is therefore built from conditions of
 the form "flag unless this observation is provably explainable":
 
-- ``kv`` (etcd register spec): a completed GET is flagged when it read
-  ABSENT after some PUT on its key definitely committed, when no PUT of
-  the observed value was even invoked before the read returned, or when
-  a *fresher* observation exists — some op completed before the read
-  began whose invoke followed the commit of the read's value (a
-  definitely-newer committed write, or an earlier read that already
-  observed a newer value — the latter catches value flip-flops that no
-  write pair alone can witness). Duplicate written values and DEL rows
-  defeat the value-identity reasoning, so their mere presence flags the
-  seed (the bundled etcd model records neither).
+- ``kv`` (etcd register spec): EXACT within a contention window — the
+  screen decides single-key register linearizability outright
+  (``kv_window_suspect``: value clusters, a writes-before-reads
+  2-cycle test, and an absent-read pass — see its docstring for the
+  argument) and falls back to "suspect" only when some key's op
+  contention exceeds ``KV_WINDOW`` concurrent ops, so a flagged lane
+  is either a real violation or an over-budget window. Duplicate
+  written values, re-invoked opids and DEL rows defeat the
+  value-identity reasoning, so their mere presence flags the seed (the
+  bundled etcd model records none of them).
 - ``log`` (kafka ordered-log spec): a completed FETCH at offset ``o``
   serving ``n`` records is flagged when fewer than ``o + n`` PRODUCE
   invocations preceded its completion, or when it breaks per-consumer
@@ -108,7 +108,12 @@ def _invoke_join(idx, valid, client, op, ph, opid, t):
 
 
 def kv_suspect(rec, t, n) -> jnp.ndarray:
-    """One seed's suspect bit under the KV register spec (etcd)."""
+    """One seed's suspect bit under the KV register spec (etcd) — the
+    ORIGINAL necessary-condition screen, superseded as the registered
+    ``kv`` screen by the exact ``kv_window_suspect`` (kept for
+    comparison: tests pin that the new screen's suspect set is a
+    subset of this one's on clean sweeps and still ⊇ the checker's
+    rejections)."""
     idx, valid, client, op, ph, key, val, opid, t = _cols(rec, t, n)
     inv_t, _ = _invoke_join(idx, valid, client, op, ph, opid, t)
 
@@ -175,6 +180,156 @@ def kv_suspect(rec, t, n) -> jnp.ndarray:
     )
     bad = get_ok & (bad_absent | no_writer | fresher)
     return jnp.any(bad) | dup | unscreenable | orphan
+
+
+# contention budget of the exact kv screen: a key whose concurrent-op
+# depth ever exceeds this many ops falls back to "suspect" (the [H, H]
+# mask cost is paid regardless — the budget bounds the CLAIM, keeping
+# the exactness argument checkable, not the compute)
+KV_WINDOW = 32
+
+
+def kv_window_suspect(rec, t, n, window: int = KV_WINDOW) -> jnp.ndarray:
+    """One seed's suspect bit under the KV register spec — EXACT within
+    a per-key contention window (the device-side linearizability
+    decision; docs/oracle.md "Device-side checking").
+
+    For a unique-value register history (duplicates/re-invokes flag
+    wholesale below), group ops into value clusters ``C_v = {the PUT
+    writing v} ∪ {completed GETs reading v}`` with ``m_v`` = earliest
+    completion among completed cluster ops (∞ if none) and ``s_v`` =
+    latest invoke in the cluster. The history is linearizable iff
+
+    (A) every completed non-ABSENT read's value has a PUT invoked no
+        later than the read completes (else no linearization can place
+        the write before the read);
+    (B) no completed non-ABSENT observation on a key completes strictly
+        before an ABSENT read of that key invokes (else some write is
+        forced before the read);
+    (C) no two clusters on one key 2-cycle: ``¬∃ u ≠ v: m_u < s_v ∧
+        m_v < s_u`` (an op of u completing before an op of v invokes
+        forces u's write before v's in EVERY linearization — edge
+        u→v; any cycle in that threshold digraph contains a 2-cycle,
+        and acyclicity yields a valid linearization by topological
+        order: ABSENT reads first, then each cluster's write followed
+        by its reads).
+
+    Necessity of each condition is immediate; sufficiency is the
+    threshold-digraph construction, with open writes that have readers
+    placed at their block's start and open ops without observers
+    omitted (the checker's optional-op semantics). Ties use strict
+    ``<`` exactly where the WGL search does (a pending op may
+    linearize before a completion at the same instant). This closes
+    the old ``kv_suspect`` conservatism gap (concurrent-write
+    flip-flops whose 2-cycle no single fresher-observation witnesses)
+    AND eliminates its false positives: a clean lane under budget is
+    *proven* clean, a flagged lane is a violation — unless the per-key
+    concurrent-op depth exceeded ``window``, the wholesale budget
+    fallback that keeps the claim honest without unbounded reasoning."""
+    idx, valid, client, op, ph, key, val, opid, t = _cols(rec, t, n)
+    inv_t, pair = _invoke_join(idx, valid, client, op, ph, opid, t)
+
+    put_inv = valid & (op == OP_PUT) & (ph == PH_INVOKE)
+    put_ok = valid & (op == OP_PUT) & (ph == PH_OK)
+    get_ok = valid & (op == OP_GET) & (ph == PH_OK)
+    obs_ok = put_ok | get_ok
+    ok_row = valid & (ph == PH_OK)
+    inv_row = valid & (ph == PH_INVOKE)
+
+    # wholesale flags: rows the value-identity argument cannot cover
+    unscreenable = jnp.any(valid & ~((op == OP_PUT) | (op == OP_GET)))
+    orphan = jnp.any(ok_row & (inv_t == _T_NEG))
+    same_client = client[:, None] == client[None, :]
+    same_opid = opid[:, None] == opid[None, :]
+    reinvoke = jnp.any(
+        inv_row[:, None]
+        & inv_row[None, :]
+        & same_client
+        & same_opid
+        & (idx[:, None] < idx[None, :])
+    )
+
+    same_key = key[:, None] == key[None, :]
+    same_val = val[:, None] == val[None, :]
+    dup = jnp.any(
+        put_inv[:, None]
+        & put_inv[None, :]
+        & same_key
+        & same_val
+        & (idx[:, None] < idx[None, :])
+    )
+
+    # an invoke row with a later matching OK row completed (re-invokes
+    # flag above, so "any match" is exact here)
+    claimed = jnp.any(pair, axis=0)
+    open_inv = inv_row & ~claimed
+    open_put = put_inv & ~claimed
+
+    # (A) — also catches a read completing before its write invokes
+    no_writer = (val != ABSENT) & ~jnp.any(
+        put_inv[None, :] & same_key & same_val & (t[None, :] <= t[:, None]),
+        axis=1,
+    )
+    bad_a = get_ok & no_writer
+
+    # (B) — GET-OK evidence included (the old screen's bad_absent only
+    # saw PUT-OK rows and missed read-witnessed writes)
+    bad_b = (
+        get_ok
+        & (val == ABSENT)
+        & jnp.any(
+            obs_ok[None, :]
+            & same_key
+            & (val[None, :] != ABSENT)
+            & (t[None, :] < inv_t[:, None]),
+            axis=1,
+        )
+    )
+
+    # (C) — cluster rows: completed observations of v plus open PUT
+    # invokes of v. m is per-CLUSTER (min completed-observation time,
+    # shared by every member row); s is per-ROW (its own invoke) — the
+    # pairwise ∃ decouples, so ∃ rows (r, q): m_r < s_q ∧ m_q < s_r
+    # iff the cluster-level 2-cycle ∃ u, v: m_u < s_v ∧ m_v < s_u
+    rep = (obs_ok | open_put) & (val != ABSENT)
+    memb = obs_ok[None, :] & same_key & same_val
+    m = jnp.min(jnp.where(memb, t[None, :], _T_INF), axis=1)
+    start = jnp.where(obs_ok, inv_t, t)
+    cyc = jnp.any(
+        rep[:, None]
+        & rep[None, :]
+        & same_key
+        & ~same_val
+        & (m[:, None] < start[None, :])
+        & (m[None, :] < start[:, None])
+    )
+
+    # window budget: per-key concurrent-op depth at each op's invoke
+    # (completed ops span [invoke, completion]; open ops never end)
+    o_mask = ok_row | open_inv
+    o_start = jnp.where(ok_row, inv_t, t)
+    o_end = jnp.where(ok_row, t, _T_INF)
+    depth = jnp.sum(
+        (
+            o_mask[:, None]
+            & o_mask[None, :]
+            & same_key
+            & (o_start[None, :] <= o_start[:, None])
+            & (o_start[:, None] <= o_end[None, :])
+        ).astype(jnp.int32),
+        axis=1,
+    )
+    over_budget = jnp.any(o_mask & (depth > jnp.int32(window)))
+
+    return (
+        jnp.any(bad_a | bad_b)
+        | cyc
+        | over_budget
+        | dup
+        | reinvoke
+        | unscreenable
+        | orphan
+    )
 
 
 def log_suspect(rec, t, n) -> jnp.ndarray:
@@ -244,7 +399,7 @@ def election_suspect(rec, t, n) -> jnp.ndarray:
 
 
 _SCREENS = {
-    "kv": kv_suspect,
+    "kv": kv_window_suspect,
     "log": log_suspect,
     "election": election_suspect,
 }
@@ -347,17 +502,26 @@ def screen_sweep(final, spec, block: int = 1024, mesh=None) -> jnp.ndarray:
     return jnp.concatenate(outs)
 
 
-def history_host_work(
-    spec,
-    max_states: int = 200_000,
-    workers: int = 0,
-    max_recorded: int = 32,
-    telemetry=None,
-) -> Callable:
-    """Build the ``host_work`` callback for a screened checked sweep
-    (engine/checkpoint.run_sweep_pipelined): decode the suspect lanes,
-    fan the WGL checker over a process pool, and fold the verdicts into
-    the chunk summary.
+class _HostWork:
+    """The host phase of a screened checked sweep: decode the suspect
+    lanes, dedup on canonical bytes, fan the WGL checker over a process
+    pool, and fold the verdicts into per-chunk report dicts.
+
+    Two consumption protocols over ONE pipeline:
+
+    - **Sync** (``host_work(final, lo=..., ...)`` — the legacy callable
+      shape every driver already speaks): submit + drain, returning the
+      chunk's report dict.
+    - **Incremental** (``submit`` / ``poll`` / ``drain`` — drivers that
+      see ``incremental = True`` may use it, e.g.
+      ``engine.stream.stream_sweep``): ``submit`` runs the cheap decode
+      + dedup immediately and queues the WGL work; ``poll(seconds=...)``
+      burns at most roughly that budget of checking (always making
+      progress when work is pending) and returns the reports of chunks
+      that FINISHED, as ``(lo, dict)`` in submission order; ``drain``
+      finishes everything. The device thereby never stalls on the
+      checker: unfinished verdict work carries across rounds and the
+      driver merges reports strictly in submission order.
 
     Suspect lanes are deduplicated before checking: identical histories
     across seeds are common under coarse faults, and the WGL verdict
@@ -368,84 +532,265 @@ def history_host_work(
     is checked; its verdict fans back to every member, and the report
     carries the class count as ``hist_unique``.
 
-    Determinism contract: the returned dict is a pure function of the
-    chunk's history planes — worker count changes wall-clock only, never
-    a byte of the report (results are ordered by lane, dedup keys on
-    content, and each verdict is a pure function of one history).
+    ``device_decode=True`` sources the canonical rows from the on-device
+    decode kernel (``history.canon_sweep``) instead of per-row host
+    Python: one fixed-shape jitted program derives every lane's paired +
+    rank-encoded rows, the host gathers just the suspect rows and hashes
+    them, and only dedup REPRESENTATIVES are materialized as ``History``
+    objects (from the canonical rows themselves — rank times, same WGL
+    verdict). The two paths produce bit-identical canonical bytes (the
+    kernel's contract, gated by scripts/check_determinism.sh) and hence
+    bit-identical reports; lanes whose rows breach the record-hook
+    contract fall back to the host decoder, which raises the diagnostic.
+
+    Determinism contract: every report dict is a pure function of its
+    chunk's history planes — worker count, poll cadence and decode path
+    change wall-clock only, never a byte (results are ordered by lane,
+    dedup keys on content, each verdict is a pure function of one
+    history, and checking is sliced in submission order).
     ``telemetry`` (``obs.Telemetry`` or None) records the suspect rate,
-    the canonical-dedup ratio, WGL pool utilization and check wall time
-    per chunk — out-of-band, never a byte of the returned dict."""
-    import hashlib
-    import time as _time
+    the canonical-dedup ratio, WGL pool utilization, check wall time
+    and budget exhaustion per chunk — out-of-band, never a report
+    byte."""
 
-    from .check import check_histories
-    from .history import decode_lanes, history_canonical_bytes
+    incremental = True
 
-    def host_work(final, *, lo, n, seeds, suspect, summary):
-        del lo, seeds, summary
-        if telemetry is not None:
-            t_check = _time.perf_counter()
+    def __init__(
+        self, spec, max_states, workers, max_recorded, telemetry,
+        device_decode,
+    ):
+        from collections import deque
+
+        self._spec = spec
+        self._max_states = max_states
+        self._workers = workers
+        self._max_recorded = max_recorded
+        self._telemetry = telemetry
+        self._device_decode = device_decode
+        # WGL slice granularity: big enough to keep a pool's workers
+        # busy per slice, small enough that a poll budget is respected
+        # within ~one slice. Scheduling-only — never affects a report
+        self._step = max(8, 4 * max(1, workers))
+        self._q: deque = deque()
+
+    def __call__(self, final, *, lo, n, seeds, suspect, summary):
+        self.submit(
+            final, lo=lo, n=n, seeds=seeds, suspect=suspect,
+            summary=summary,
+        )
+        return self.drain()[-1][1]
+
+    def submit(self, final, *, lo, n, seeds, suspect, summary) -> None:
+        """Decode + dedup one chunk now; queue its WGL work."""
+        import hashlib
+        import time as _time
+
+        from .history import (
+            canon_sweep,
+            canonical_bytes_from_rows,
+            decode_lanes,
+            history_canonical_bytes,
+            history_from_canon,
+        )
+
+        del seeds, summary
+        t0 = _time.perf_counter()
+        n = int(n)
         if suspect is None:
             lanes = np.arange(n)
         else:
             lanes = np.nonzero(np.asarray(suspect)[:n])[0]
-        hists = decode_lanes(final, lanes)
-        keys = [
-            hashlib.sha256(history_canonical_bytes(h)).digest()
-            for h in hists
-        ]
         rep: dict = {}  # canonical hash -> index into reps
-        reps = []
-        for h, k in zip(hists, keys):
-            if k not in rep:
-                rep[k] = len(reps)
-                reps.append(h)
-        rep_results = check_histories(
-            reps, spec, max_states=max_states, workers=workers
-        )
-        results = [rep_results[rep[k]] for k in keys]
-        bad = [int(h.seed) for h, r in zip(hists, results) if not r.ok]
-        undecided = sum(1 for r in results if not r.decided)
-        if telemetry is not None:
-            telemetry.count("oracle_screened_total", int(n))
-            telemetry.count("oracle_suspects_total", int(lanes.size))
-            telemetry.count("oracle_unique_total", len(reps))
-            if bad:
-                telemetry.count("oracle_violations_total", len(bad))
-            telemetry.gauge(
+        reps: list = []
+        keys: list = []
+        lane_seeds: list = []
+        if self._device_decode and int(final.hist_rec.shape[1]) > 0:
+            canon, n_ops, breach = canon_sweep(final)
+            total = int(final.seed.shape[0])
+            if lanes.size and lanes.size * 4 <= total:
+                # sparse selection: gather device-side (decode_lanes'
+                # transfer-sizing rule), positions then index the gather
+                planes = (
+                    canon[lanes], n_ops[lanes], breach[lanes],
+                    final.hist_len[lanes], final.hist_overflow[lanes],
+                    final.seed[lanes],
+                )
+                pos = np.arange(lanes.size)
+            else:
+                planes = (
+                    canon, n_ops, breach, final.hist_len,
+                    final.hist_overflow, final.seed,
+                )
+                pos = lanes
+            rows_c, nops_h, br_h, len_h, ov_h, seed_h = (
+                np.asarray(p) for p in planes
+            )
+            for j, p in enumerate(pos):
+                if br_h[p]:
+                    # record-hook contract breach: the host decoder
+                    # raises the real diagnostic for this lane
+                    decode_lanes(final, [int(lanes[j])])
+                    raise RuntimeError(
+                        f"device canonical decode flagged lane "
+                        f"{int(lanes[j])} but the host decoder "
+                        "accepted it"
+                    )
+                keys.append(
+                    hashlib.sha256(
+                        canonical_bytes_from_rows(
+                            rows_c[p], nops_h[p], len_h[p], ov_h[p]
+                        )
+                    ).digest()
+                )
+                lane_seeds.append(int(seed_h[p]))
+                if keys[-1] not in rep:
+                    rep[keys[-1]] = len(reps)
+                    reps.append(
+                        history_from_canon(
+                            rows_c[p], nops_h[p], ov_h[p], len_h[p],
+                            seed=lane_seeds[-1],
+                        )
+                    )
+        else:
+            hists = decode_lanes(final, lanes)
+            for h in hists:
+                k = hashlib.sha256(history_canonical_bytes(h)).digest()
+                keys.append(k)
+                lane_seeds.append(int(h.seed))
+                if k not in rep:
+                    rep[k] = len(reps)
+                    reps.append(h)
+        if self._telemetry is not None:
+            self._telemetry.count("oracle_screened_total", n)
+            self._telemetry.count("oracle_suspects_total", int(lanes.size))
+            self._telemetry.count("oracle_unique_total", len(reps))
+            self._telemetry.gauge(
                 "oracle_suspect_rate", lanes.size / max(n, 1),
                 help="suspect lanes / screened lanes, last chunk",
             )
             if lanes.size:
-                telemetry.gauge(
+                self._telemetry.gauge(
                     "oracle_dedup_ratio", len(reps) / lanes.size,
                     help="unique canonical histories / suspects "
                     "(lower = more dedup wins)",
+                )
+        self._q.append(
+            {
+                "lo": lo, "n": n, "suspects": int(lanes.size),
+                "keys": keys, "seeds": lane_seeds, "rep": rep,
+                "reps": reps, "results": [], "next": 0,
+                "host_s": _time.perf_counter() - t0,
+            }
+        )
+
+    def poll(self, seconds: Optional[float] = None) -> list:
+        """Run queued WGL work for roughly ``seconds`` (None = until
+        empty); returns ``(lo, report_dict)`` for every chunk that
+        finished, in submission order. Always makes progress when work
+        is pending (at least one slice per call), so a starved budget
+        degrades to trickling, never to deadlock. The budget shapes
+        SCHEDULING only: verdicts are computed in submission order
+        regardless, so the stream of returned reports — and every byte
+        in them — is invariant to the poll cadence."""
+        import time as _time
+
+        from .check import check_histories
+
+        out = []
+        deadline = (
+            None if seconds is None else _time.perf_counter() + seconds
+        )
+        sliced = False
+        while self._q:
+            e = self._q[0]
+            reps = e["reps"]
+            while e["next"] < len(reps):
+                if (
+                    deadline is not None
+                    and sliced
+                    and _time.perf_counter() >= deadline
+                ):
+                    return out
+                j = min(len(reps), e["next"] + self._step)
+                tc = _time.perf_counter()
+                e["results"].extend(
+                    check_histories(
+                        reps[e["next"]: j], self._spec,
+                        max_states=self._max_states,
+                        workers=self._workers,
+                    )
+                )
+                e["host_s"] += _time.perf_counter() - tc
+                e["next"] = j
+                sliced = True
+            out.append((e["lo"], self._finalize(e)))
+            self._q.popleft()
+        return out
+
+    def drain(self) -> list:
+        """Finish ALL queued work; ``(lo, report_dict)`` in submission
+        order."""
+        return self.poll(None)
+
+    def _finalize(self, e: dict) -> dict:
+        rep_results = e["results"]
+        results = [rep_results[e["rep"][k]] for k in e["keys"]]
+        bad = [s for s, r in zip(e["seeds"], results) if not r.ok]
+        undecided = sum(1 for r in results if not r.decided)
+        # distinct WGL searches that hit max_states (vs hist_undecided,
+        # which counts the lanes those verdicts fanned out to)
+        exhausted = sum(1 for r in rep_results if not r.decided)
+        reps, workers = e["reps"], self._workers
+        if self._telemetry is not None:
+            if bad:
+                self._telemetry.count("oracle_violations_total", len(bad))
+            if exhausted:
+                self._telemetry.count(
+                    "oracle_budget_exceeded_total", exhausted,
+                    help="WGL searches that exhausted max_states "
+                    "(verdict undecided, fails clean)",
                 )
             if workers > 0 and reps:
                 # load-balance proxy: busy slots / pool slots over the
                 # batch's -(-len // workers) waves
                 waves = -(-len(reps) // workers)
-                telemetry.gauge(
+                self._telemetry.gauge(
                     "oracle_pool_utilization",
                     len(reps) / (workers * waves),
                     help="checked histories / (workers x waves), "
                     "last chunk",
                 )
-            telemetry.observe(
-                "oracle_check_seconds", _time.perf_counter() - t_check,
+            self._telemetry.observe(
+                "oracle_check_seconds", e["host_s"],
                 help="decode+dedup+WGL check per chunk",
             )
         return {
-            "hist_screened": int(n),
-            "hist_suspects": int(lanes.size),
+            "hist_screened": e["n"],
+            "hist_suspects": e["suspects"],
             "hist_unique": len(reps),
             "hist_violations": len(bad),
             "hist_undecided": int(undecided),
-            "hist_violating_seeds": bad[:max_recorded],
+            "budget_exceeded": int(exhausted),
+            "hist_violating_seeds": bad[: self._max_recorded],
         }
 
-    return host_work
+
+def history_host_work(
+    spec,
+    max_states: int = 200_000,
+    workers: int = 0,
+    max_recorded: int = 32,
+    telemetry=None,
+    device_decode: bool = False,
+) -> Callable:
+    """Build the ``host_work`` for a screened checked sweep — a
+    ``_HostWork``: callable with the legacy per-chunk signature (every
+    driver's sync path), and exposing ``submit``/``poll``/``drain`` for
+    drivers that interleave checking with device rounds (see the class
+    docstring for both protocols and the determinism contract)."""
+    return _HostWork(
+        spec, max_states, workers, max_recorded, telemetry, device_decode
+    )
 
 
 def checked_sweep(
@@ -467,6 +812,7 @@ def checked_sweep(
     on_chunk=None,
     driver: str = "chunked",
     telemetry=None,
+    device_decode: bool = False,
 ) -> dict:
     """End-to-end checked sweep: pipelined chunked sweep + on-device
     screening + process-pool WGL checking, merged into one summary dict.
@@ -499,7 +845,12 @@ def checked_sweep(
     same virtual chunk boundaries, same merge order. The stream driver
     keeps its own checkpoint semantics (``stream_sweep(ckpt_path=...)``),
     so the chunk-granule ``ckpt_dir``/``stop_after``/``resume_from``
-    arguments are rejected here."""
+    arguments are rejected here.
+
+    ``device_decode=True`` sources canonical history rows from the
+    on-device decode kernel instead of per-row host Python
+    (``history_host_work``) — bit-identical reports either way, gated
+    by the determinism suite's decode leg."""
     from ..engine.checkpoint import run_sweep_pipelined
 
     if driver not in ("chunked", "stream"):
@@ -515,6 +866,7 @@ def checked_sweep(
     host_work = history_host_work(
         spec, max_states=max_states, workers=workers,
         max_recorded=max_recorded, telemetry=telemetry,
+        device_decode=device_decode,
     )
     if driver == "stream":
         from ..engine.core import pick_chunk_size

@@ -10,6 +10,9 @@
 # both the sweep and the traced-replay path, so the same (spec, seed)
 # must yield byte-identical canonical history bytes across the two
 # processes AND across the two paths (docs/oracle.md contract).
+# A decode leg re-runs the checked sweep with canonical rows sourced
+# from the on-device decode kernel and byte-diffs against the
+# host-decode reports (docs/oracle.md device-side checking contract).
 # A telemetry leg re-runs the streaming checked sweep and the campaign
 # under a full obs.Telemetry handle and byte-diffs against the
 # uninstrumented reports (docs/observability.md out-of-band contract).
@@ -183,6 +186,29 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     echo "determinism gate: FAILED — streaming checked-sweep reports differ from chunked or are empty" >&2
     for f in "$out"/cs_*stream*.json; do echo "--- $f"; cat "$f"; done >&2 || true
     cat "$out"/cs_*stream*.log >&2 || true
+    exit 1
+  fi
+
+  # decode leg (docs/oracle.md "Device-side checking"): the SAME
+  # checked-sweep report must be byte-identical across two processes x
+  # two DECODE PATHS — canonical history rows sourced from the jitted
+  # on-device decode kernel vs per-row host Python. Compared against
+  # the unsharded w0 (host-decode) reference above, so the device
+  # kernel joins the one pinned byte string: same dedup keys, same
+  # rebuilt histories, same verdicts, bit for bit.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/checked_sweep_demo.py \
+      --seeds 96 --chunk-size 32 --workers 0 --device-decode \
+      --report "$out/cs_${r}_dd.json" >"$out/cs_${r}_dd.log" 2>&1
+  done
+  if [ -s "$out/cs_a_dd.json" ] \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_a_dd.json" \
+    && cmp -s "$out/cs_a_w0.json" "$out/cs_b_dd.json"; then
+    echo "determinism gate: OK (device-decode checked sweep, 2 processes x 2 decode paths, byte-identical)"
+  else
+    echo "determinism gate: FAILED — device-decode checked-sweep reports differ from host-decode or are empty" >&2
+    for f in "$out"/cs_*_dd.json; do echo "--- $f"; cat "$f"; done >&2 || true
+    cat "$out"/cs_*_dd.log >&2 || true
     exit 1
   fi
 

@@ -17,6 +17,13 @@ stderr, where the gate ignores it.
 Usage: python scripts/checked_sweep_demo.py [--seeds N] [--chunk-size C]
            [--workers W] [--clean] [--report PATH] [--mesh N]
            [--driver chunked|stream] [--telemetry-dir DIR]
+           [--device-decode]
+
+``--device-decode`` sources canonical history rows from the jitted
+on-device decode kernel (``oracle.history.canon_sweep``) instead of
+per-row host Python — the report must be byte-identical either way;
+the gate's decode leg runs 2 processes x {device, host} and diffs all
+four.
 
 ``--telemetry-dir DIR`` runs the identical pipeline under a full
 ``obs.Telemetry`` handle (metrics + journal + trace spans written to
@@ -74,6 +81,13 @@ def main() -> int:
         "trace written HERE); the report bytes must not depend on this "
         "(the telemetry leg of check_determinism.sh diffs on vs off)",
     )
+    ap.add_argument(
+        "--device-decode", action="store_true",
+        help="source canonical history rows from the on-device decode "
+        "kernel instead of per-row host Python; the report bytes must "
+        "not depend on this (the decode leg of check_determinism.sh "
+        "diffs the two)",
+    )
     args = ap.parse_args()
 
     mesh = None
@@ -114,6 +128,7 @@ def main() -> int:
         wl, ecfg, seeds, etcd.history_spec(), etcd.sweep_summary,
         chunk_size=args.chunk_size, workers=args.workers, mesh=mesh,
         driver=args.driver, telemetry=telem,
+        device_decode=args.device_decode,
     )
     wall = time.perf_counter() - t0
     if telem is not None:

@@ -39,10 +39,15 @@ from madsim_tpu.oracle.history import (
     OP_PUT,
     PH_INVOKE,
     PH_OK,
+    canonical_bytes_from_rows,
     decode_rows,
+    history_canonical_bytes,
+    history_from_canon,
 )
 from madsim_tpu.oracle.screen import (
     checked_sweep,
+    history_host_work,
+    kv_window_suspect,
     screen_history,
     screen_sweep,
 )
@@ -378,6 +383,7 @@ def _serial_checked(wl, ecfg, seeds, spec, chunk_size):
                 ),
                 "hist_violations": len(bad),
                 "hist_undecided": 0,
+                "budget_exceeded": 0,
                 "hist_violating_seeds": bad[:32],
             }
         )
@@ -532,3 +538,171 @@ def test_inflight_checkpoint_resume_is_bit_identical(tmp_path):
     plain = str(tmp_path / "plain.npz")
     eckpt.save_sweep(partial, plain)
     assert eckpt.load_inflight(plain) is None
+
+
+# -- device-side canonical decode (docs/oracle.md "Device-side checking") ----
+
+
+def _canon_device(rec, ts, n):
+    """Run the jitted canonical-decode kernel on one hand-written lane."""
+    from madsim_tpu.oracle.history import _canon_kernel
+
+    canon, n_ops, breach = _canon_kernel()(
+        jnp.asarray(rec)[None],
+        jnp.asarray(ts)[None],
+        jnp.asarray([n], jnp.int32),
+    )
+    return np.asarray(canon)[0], int(n_ops[0]), bool(breach[0])
+
+
+_CANON_FIXTURES = {
+    "stale_read": (
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (0, OP_PUT, PH_INVOKE, 3, 7, 1, 150),
+        (0, OP_PUT, PH_OK, 3, 7, 1, 250),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 300),
+        (1, OP_GET, PH_OK, 3, 5, 0, 400),
+    ),
+    "open_ops": (
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),  # ack lost: stays open
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 300),
+        (1, OP_GET, PH_OK, 3, 5, 0, 400),
+        (2, OP_GET, PH_INVOKE, 3, 0, 0, 500),  # open at buffer end
+    ),
+    "tied_times": (
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 10),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 10),  # tie with the put invoke
+        (0, OP_PUT, PH_OK, 3, 5, 0, 20),
+        (1, OP_GET, PH_OK, 3, 5, 0, 20),  # tie with the put ok
+    ),
+    "reinvoked_opid": (
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (0, OP_PUT, PH_INVOKE, 3, 6, 0, 100),  # same opid re-invoked
+        (0, OP_PUT, PH_OK, 3, 6, 0, 200),  # pairs with the LATER invoke
+        (1, OP_GET, PH_INVOKE, 4, 0, 0, 250),
+        (1, OP_GET, PH_OK, 4, -1, 0, 300),
+    ),
+}
+
+
+@pytest.mark.parametrize("name", sorted(_CANON_FIXTURES))
+@pytest.mark.parametrize("overflow", [False, True])
+def test_canon_kernel_bytes_match_host(name, overflow):
+    """The tentpole byte contract on hand-written lanes: the device
+    kernel's canonical rows encode to EXACTLY the host decode's bytes —
+    ties, open ops, re-invoked opids, and the overflow header included —
+    and the rank-rebuilt history gets the same checker verdict."""
+    rec, ts, n = _rows(*_CANON_FIXTURES[name])
+    rows_dev, n_ops, breach = _canon_device(rec, ts, n)
+    assert not breach
+    dev = canonical_bytes_from_rows(rows_dev, n_ops, n, overflow)
+    hist = decode_rows(rec, ts, n, overflow)
+    assert dev == history_canonical_bytes(hist)
+    rebuilt = history_from_canon(rows_dev, n_ops, overflow, n)
+    assert (
+        check_history(rebuilt, KVSpec()).ok
+        == check_history(hist, KVSpec()).ok
+    )
+
+
+def test_canon_kernel_flags_record_breach():
+    """An OK row with no matching invoke (or a mismatched one) is a
+    record-hook contract breach — the kernel must refuse (flag), not
+    emit rows the host path would raise on."""
+    rec, ts, n = _rows(
+        (1, OP_GET, PH_OK, 3, 5, 0, 100),  # orphan: no invoke row
+    )
+    _, _, breach = _canon_device(rec, ts, n)
+    assert breach
+    rec, ts, n = _rows(
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (0, OP_GET, PH_OK, 4, 5, 0, 100),  # same (client, opid), wrong op+key
+    )
+    _, _, breach = _canon_device(rec, ts, n)
+    assert breach
+
+
+# -- the bounded-window KV screen --------------------------------------------
+
+
+def test_kv_window_budget_forces_suspect():
+    """Conservatism when the contention window overflows: a perfectly
+    linearizable pileup of overlapping same-key ops must screen clean
+    under the default window and SUSPECT under a window it exceeds —
+    the fallback that keeps the bounded screen sound at any depth."""
+    items = [
+        (0, OP_PUT, PH_INVOKE, 3, 5, 0, 0),
+        (1, OP_GET, PH_INVOKE, 3, 0, 0, 10),
+        (2, OP_GET, PH_INVOKE, 3, 0, 0, 20),
+        (0, OP_PUT, PH_OK, 3, 5, 0, 100),
+        (1, OP_GET, PH_OK, 3, 5, 0, 110),
+        (2, OP_GET, PH_OK, 3, 5, 0, 120),
+    ]
+    rec, ts, n = _rows(*items)
+    assert check_history(decode_rows(rec, ts, n, False), KVSpec()).ok
+    assert not bool(kv_window_suspect(rec, ts, n))
+    assert bool(kv_window_suspect(rec, ts, n, window=1))
+
+
+def test_kv_window_screen_reduces_suspects(etcd_bug_final):
+    """The acceptance pin: on the seeded-bug sweep the exact-in-window
+    screen flags strictly FEWER lanes than it screens (the old
+    value-staleness heuristic's margin is gone), while conservatism
+    holds (test_screen_conservative_on_etcd_stale_bug)."""
+    mask = np.asarray(screen_sweep(etcd_bug_final, KVSpec()))
+    assert mask.any()
+    assert int(mask.sum()) < int(mask.size)
+
+
+# -- the incremental host-work protocol --------------------------------------
+
+
+def test_host_work_incremental_and_device_decode_equal(etcd_bug_final):
+    """One pipeline, three consumptions — legacy sync call, explicit
+    submit/poll/drain, and the device-decode path — must produce the
+    IDENTICAL report dict (the byte contract behind every driver)."""
+    final = etcd_bug_final
+    S = int(np.asarray(final.seed).size)
+    mask = np.asarray(screen_sweep(final, KVSpec()))
+    sus = mask & (np.arange(S) < 8)  # cap the WGL cost: <=8 lanes
+    assert sus.any()
+    seeds = np.asarray(final.seed)
+    kw = dict(lo=0, n=S, seeds=seeds, suspect=sus, summary={})
+    sync = history_host_work(KVSpec())(final, **kw)
+    hw = history_host_work(KVSpec())
+    hw.submit(final, **kw)
+    finished = []
+    while not finished:
+        finished = hw.poll(0.0)  # starved budget still progresses
+    assert finished == [(0, sync)]
+    assert hw.drain() == []
+    dev = history_host_work(KVSpec(), device_decode=True)(final, **kw)
+    assert dev == sync
+    assert sync["hist_suspects"] == int(sus.sum())
+    assert sync["budget_exceeded"] == 0
+
+
+def test_budget_exceeded_surfaces(etcd_bug_final):
+    """A starved WGL state budget must be VISIBLE, not silent: the
+    report's budget_exceeded counts the undecided searches, undecided
+    lanes are never reported as violations, and violating_seeds exposes
+    the same honesty through its stats out-param."""
+    final = etcd_bug_final
+    S = int(np.asarray(final.seed).size)
+    mask = np.asarray(screen_sweep(final, KVSpec()))
+    sus = mask & (np.arange(S) < 8)
+    report = history_host_work(KVSpec(), max_states=1)(
+        final, lo=0, n=S, seeds=np.asarray(final.seed), suspect=sus,
+        summary={},
+    )
+    assert report["budget_exceeded"] >= 1
+    assert report["hist_undecided"] >= 1
+    assert report["hist_violations"] == 0
+    stats: dict = {}
+    out = violating_seeds(
+        final, KVSpec(), max_states=1, screen=lambda _f: sus, stats=stats
+    )
+    assert out.size == 0
+    assert stats["checked"] == int(sus.sum())
+    assert stats["budget_exceeded"] >= 1
