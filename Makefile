@@ -18,11 +18,15 @@
 #                      (spec, seed) grids incl. every gray-failure
 #                      family, outcome distributions within tolerances,
 #                      both tiers' histories checked by one spec
-#   make wire-smoke    heavy-traffic Kafka-binary-wire gate: concurrent
-#                      genuine-protocol clients (producers + a consumer
-#                      group) against the sim broker under a latency
-#                      burst, LogSpec-checked history, live-vs-replay
-#                      byte identity, plus a differential-fuzz sweep
+#   make wire-smoke    heavy-traffic wire gate, both tiers: the sim-tier
+#                      Kafka leg (concurrent genuine-protocol clients
+#                      against the sim broker under a latency burst,
+#                      LogSpec-checked history, live-vs-replay byte
+#                      identity, differential-fuzz sweep) plus the async
+#                      serving core's load rig at small scale (worker
+#                      processes, kafka+s3+etcd wires, chaos mid-run,
+#                      oracle-checked histories, async-vs-legacy
+#                      transcript parity — docs/wire.md)
 #   make multichip-smoke
 #                      sharded checked-sweep pipeline on the CPU host
 #                      mesh: device-count curve + a small sharded
@@ -101,10 +105,14 @@ differential-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/differential_demo.py
 
 # the kafka wire under concurrent genuine-protocol load + fuzz
-# (scripts/wire_load_demo.py docstring has the three determinism claims)
+# (scripts/wire_load_demo.py docstring has the three determinism claims),
+# then the async serving core's rig at small scale: worker processes x
+# kafka+s3+etcd wires, gray failure mid-run, oracle-checked histories,
+# replay identity, async-vs-legacy parity (scripts/wire_load.py --smoke)
 wire-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/wire_load_demo.py
 	$(PY) scripts/wire_load_demo.py --fuzz 12
+	JAX_PLATFORMS=cpu $(PY) scripts/wire_load.py --smoke
 
 # the sharded checked-sweep pipeline on the CPU host mesh
 # (docs/multichip.md): device-count curve + small campaign, bytes

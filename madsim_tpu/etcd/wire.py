@@ -1045,7 +1045,14 @@ def _make_concurrency_services(pkg, svc: EtcdService):
 
 class WireServer:
     """Serve an :class:`EtcdService` over genuine etcd v3 gRPC wire
-    (real mode: grpc.aio transport + wall-clock lease ticks)."""
+    (real mode: grpc.aio transport + wall-clock lease ticks).
+
+    Deliberately NOT on the shared serving core (``madsim_tpu/serve/``):
+    grpc.aio owns its HTTP/2 accept loop, flow control, and framing
+    end-to-end, so there is no seam to plug an adapter into. The framed
+    etcd tier (``real/etcd.py``) — same EtcdService, same dispatcher —
+    is the one the core multiplexes; see docs/wire.md.
+    """
 
     def __init__(self, service: Optional[EtcdService] = None):
         self.service = service or EtcdService()

@@ -1328,14 +1328,99 @@ class SimWireServer:
 class WireServer:
     """Serve the genuine Kafka wire on a real TCP port (wall-clock
     timestamps) — what ``real.kafka.SimBroker.serve`` now runs by
-    default, and what a stock client connects to."""
+    default, and what a stock client connects to.
 
-    def __init__(self, broker: Optional[Broker] = None, telemetry=None):
+    The accept loop, framing, backpressure, and lifecycle metrics live
+    in the shared serving core (``madsim_tpu/serve/``); this class is
+    the thin Kafka adapter over it: ``KafkaWire.handle_frame`` stays a
+    pure function of (request bytes, clock), so the live-vs-replay
+    byte-identity gate holds through the core unchanged.
+    ``clock_ms=`` injects a deterministic clock (the determinism leg);
+    ``shards=`` spreads accepts over N SO_REUSEPORT loops.
+    """
+
+    def __init__(self, broker: Optional[Broker] = None, telemetry=None,
+                 clock_ms: Optional[Callable[[], int]] = None,
+                 shards: int = 1,
+                 advertised: Optional[Tuple[str, int]] = None):
         self.broker = broker or Broker()
         self.telemetry = telemetry
         self.wire: Optional[KafkaWire] = None
         self.bound_addr: Optional[Tuple[str, int]] = None
+        self._clock_ms = clock_ms
+        self._shards = shards
+        self._core = None
+        # determinism legs pin this: Metadata/FindCoordinator responses
+        # embed the advertised address, and an ephemeral bound port
+        # would leak into the transcript hash
+        self._advertised = advertised
+
+    @staticmethod
+    def _now_ms() -> int:
+        import time as _walltime
+
+        return _walltime.time_ns() // 1_000_000
+
+    def _count_conn(self, _conn) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "kafka_connections_total", help="accepted connections"
+            )
+
+    async def start(self, addr: "str | tuple") -> None:
+        from ..serve import AsyncWireServer, PureFrameAdapter
+
+        adapter = PureFrameAdapter(
+            self._handle, name="kafka",
+            drop_errors=(WireError, KeyError, ValueError, struct.error),
+            connect_hook=self._count_conn,
+        )
+        self._core = AsyncWireServer(
+            adapter, telemetry=self.telemetry, shards=self._shards
+        )
+        self.bound_addr = await self._core.start(addr)
+        self.wire = KafkaWire(
+            self.broker, self._clock_ms or self._now_ms,
+            self._advertised or self.bound_addr,
+            telemetry=self.telemetry,
+        )
+
+    def _handle(self, req: bytes) -> Optional[bytes]:
+        return self.wire.handle_frame(req)
+
+    async def serve(self, addr: "str | tuple") -> None:
+        await self.start(addr)
+        try:
+            await self._core._stopped.wait()
+        finally:
+            self._core._teardown()
+
+    def close(self) -> None:
+        if self._core is not None:
+            self._core.close()
+
+    async def aclose(self, drain_timeout: float = 5.0) -> None:
+        """Graceful drain — in-flight frames answered, queues flushed."""
+        if self._core is not None:
+            await self._core.aclose(drain_timeout)
+
+
+class LegacyWireServer:
+    """The pre-core thread-of-control per connection server (one
+    asyncio streams task per conn, unbounded write buffering). Kept as
+    the A/B baseline for the determinism and parity gates; deprecated
+    for serving — see docs/wire.md."""
+
+    def __init__(self, broker: Optional[Broker] = None, telemetry=None,
+                 clock_ms: Optional[Callable[[], int]] = None,
+                 advertised: Optional[Tuple[str, int]] = None):
+        self.broker = broker or Broker()
+        self.telemetry = telemetry
+        self.wire: Optional[KafkaWire] = None
+        self.bound_addr: Optional[Tuple[str, int]] = None
+        self._clock_ms = clock_ms
         self._server = None
+        self._advertised = advertised
 
     @staticmethod
     def _now_ms() -> int:
@@ -1352,7 +1437,8 @@ class WireServer:
         self._server = await asyncio.start_server(self._conn, host, port)
         self.bound_addr = self._server.sockets[0].getsockname()[:2]
         self.wire = KafkaWire(
-            self.broker, self._now_ms, self.bound_addr,
+            self.broker, self._clock_ms or self._now_ms,
+            self._advertised or self.bound_addr,
             telemetry=self.telemetry,
         )
 

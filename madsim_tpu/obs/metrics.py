@@ -221,6 +221,12 @@ class Registry:
             return None
         return m.get(**labels)
 
+    def metric(self, name: str):
+        """The metric family object itself (or None) — for callers that
+        need ``series()``/``buckets`` rather than one child value."""
+        with self._lock:
+            return self._metrics.get(name)
+
     def collect(self) -> Iterable[Tuple[str, str, str, Tuple[str, ...], list]]:
         """Snapshot every family: ``(name, kind, help, labelnames,
         series)`` tuples, name-sorted — the renderer's input."""

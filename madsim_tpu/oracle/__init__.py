@@ -42,7 +42,7 @@ from .history import (
     decode_sweep,
     history_bytes,
 )
-from .specs import ElectionSpec, KVSpec, LogSpec
+from .specs import ElectionSpec, KVSpec, LogSpec, S3Spec
 
 __all__ = [
     "CheckResult",
@@ -61,4 +61,5 @@ __all__ = [
     "ElectionSpec",
     "KVSpec",
     "LogSpec",
+    "S3Spec",
 ]

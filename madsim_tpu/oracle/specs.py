@@ -146,6 +146,48 @@ class LogSpec(Spec):
         return None
 
 
+class S3Spec(Spec):
+    """Per-object last-writer-wins register — the S3 sequential spec
+    (the one model that had none; ROADMAP item 4).
+
+    S3's data plane is a flat namespace of whole-object registers:
+    PutObject replaces the value atomically (multipart upload included —
+    its parts become visible only at CompleteMultipartUpload, which is
+    the single atomic write the history records), GetObject observes
+    exactly the current value, DeleteObject unsets it and a subsequent
+    GET must observe absence. Recorded with the KV op vocabulary
+    (OP_PUT/OP_GET/OP_DEL) but under its own name: values are content
+    *fingerprints* (a 63-bit digest of the object body), ``ABSENT``
+    encodes both "404" and "never written". One object key = one
+    partition (S3 promises nothing across keys).
+
+    What distinguishes it from ``KVSpec`` semantically is the failure
+    envelope the load rig leans on: a completed GET with ``out ==
+    ABSENT`` after any successful PUT of that key is only legal if a
+    DELETE (or nothing) linearizes between them — torn multipart
+    visibility or a lost PUT under an fsync stall shows up as exactly
+    that inconsistency.
+    """
+
+    name = "s3"
+
+    def init(self):
+        return ABSENT
+
+    def apply(self, state, op: Op):
+        if op.op == OP_PUT:
+            return True, op.inp
+        if op.op == OP_DEL:
+            return True, ABSENT
+        if op.op == OP_GET:
+            ok = (not op.complete) or op.out == state
+            return ok, state
+        return False, state
+
+    def partition_of(self, op: Op) -> int:
+        return op.key
+
+
 class ElectionSpec(Spec):
     """Raft election safety as a sequential spec: at most one leader per
     term.

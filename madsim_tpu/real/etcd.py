@@ -76,7 +76,15 @@ def _asyncio_future() -> "asyncio.Future":
 
 
 class Server(_SimServer):
-    """The EtcdService dispatcher on a real listener + wall-clock ticks."""
+    """The EtcdService dispatcher on a real listener + wall-clock ticks.
+
+    Serving rides the shared core (``madsim_tpu/serve/``): the pull-
+    style ``_serve_conn(tx, rx)`` dispatcher is unchanged — a
+    ``ChannelAdapter`` recreates the pipe surface per connection while
+    the core owns sockets, framing, backpressure, and metrics. (The
+    grpcio wire tier, ``etcd/wire.py``, keeps its own HTTP/2 accept
+    loop — grpc.aio owns it; see docs/wire.md.)
+    """
 
     _spawn = staticmethod(spawn)
     _sleep = staticmethod(rtime.sleep)
@@ -88,13 +96,38 @@ class Server(_SimServer):
         return await stream.StreamListener.bind(addr)
 
     async def serve(self, addr: "str | tuple") -> None:
+        from ..serve import AsyncWireServer, ChannelAdapter
+
         # watchers must block on asyncio futures, not sim futures
         self.service.bus.future_factory = _asyncio_future
-        await super().serve(addr)
+        adapter = ChannelAdapter(self._serve_conn, codec, name="etcd")
+        self._core = AsyncWireServer(adapter, telemetry=self.telemetry)
+        self.bound_addr = await self._core.start(addr)
+        tick = spawn(self._tick_loop(), name="etcd-tick")
+        try:
+            await self._core._stopped.wait()
+        finally:
+            self._core._teardown()
+            tick.cancel()
+
+    def close(self) -> None:
+        core = getattr(self, "_core", None)
+        if core is not None:
+            core.close()
 
     @staticmethod
     def builder() -> "ServerBuilder":
         return ServerBuilder()
+
+
+class LegacyServer(Server):
+    """The pre-core accept loop (``StreamListener.accept1`` + one task
+    per connection) — the A/B baseline for parity gates; deprecated for
+    serving."""
+
+    async def serve(self, addr: "str | tuple") -> None:
+        self.service.bus.future_factory = _asyncio_future
+        await _SimServer.serve(self, addr)
 
 
 class ServerBuilder(_SimServerBuilder):
@@ -127,6 +160,7 @@ __all__ = [
     "GetOptions",
     "KeyValue",
     "LeaderKey",
+    "LegacyServer",
     "PutOptions",
     "Server",
     "ServerBuilder",

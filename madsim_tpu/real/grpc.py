@@ -93,13 +93,43 @@ class ServiceClient(_SimServiceClient):
 
 
 class Router(_SimRouter):
-    """The sim router/dispatcher serving on a real TCP listener."""
+    """The sim router/dispatcher serving on a real TCP listener.
+
+    Connections are multiplexed by the shared serving core
+    (``madsim_tpu/serve/``); the per-connection dispatcher
+    (``_serve_conn``) is unchanged, fed through a ``ChannelAdapter``.
+    """
 
     _spawn = staticmethod(spawn)
 
     @staticmethod
     async def _bind(addr: "str | tuple") -> Any:
         return await stream.StreamListener.bind(addr)
+
+    async def serve_with_shutdown(
+        self, addr: "str | tuple", signal: "Any | None"
+    ) -> None:
+        import asyncio
+
+        from ..serve import AsyncWireServer, ChannelAdapter
+
+        adapter = ChannelAdapter(self._serve_conn, codec, name="grpc")
+        self._core = AsyncWireServer(adapter)
+        self.bound_addr = await self._core.start(addr)
+        try:
+            if signal is None:
+                await self._core._stopped.wait()
+            else:
+                stop = asyncio.ensure_future(self._core._stopped.wait())
+                sig = asyncio.ensure_future(signal)
+                _done, pending = await asyncio.wait(
+                    {stop, sig}, return_when=asyncio.FIRST_COMPLETED
+                )
+                for p in pending:
+                    p.cancel()
+        finally:
+            self._core.close()
+            self._core._teardown()
 
 
 class ServerBuilder(_SimServerBuilder):

@@ -87,8 +87,10 @@ class SimBroker(_SimBroker):
         self.wire_server = ws
         await ws.start(addr)
         self.bound_addr = ws.bound_addr
-        async with ws._server:
-            await ws._server.serve_forever()
+        try:
+            await ws._core._stopped.wait()
+        finally:
+            ws._core._teardown()
 
 
 Broker = SimBroker  # the natural real-mode name

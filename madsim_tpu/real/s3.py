@@ -24,12 +24,16 @@ from ..s3.client import (
 )
 from ..s3.server import SimServer as _SimServer
 from ..s3.service import S3Error, S3Service
-from . import stream
+from . import codec, stream
 from .runtime import spawn
 
 
 class SimServer(_SimServer):
-    """The S3Service dispatcher on a real listener, wall-clock mtimes."""
+    """The S3Service dispatcher on a real listener, wall-clock mtimes.
+
+    Serving rides the shared core (``madsim_tpu/serve/``) through a
+    ``ChannelAdapter``: the one-exchange ``_serve_conn(tx, rx)``
+    dispatcher is unchanged."""
 
     _spawn = staticmethod(spawn)
 
@@ -39,6 +43,30 @@ class SimServer(_SimServer):
 
     def _now_ms(self) -> int:
         return _walltime.time_ns() // 1_000_000
+
+    async def serve(self, addr: "str | tuple") -> None:
+        from ..serve import AsyncWireServer, ChannelAdapter
+
+        adapter = ChannelAdapter(self._serve_conn, codec, name="s3-enum")
+        self._core = AsyncWireServer(adapter)
+        self.bound_addr = await self._core.start(addr)
+        try:
+            await self._core._stopped.wait()
+        finally:
+            self._core._teardown()
+
+    def close(self) -> None:
+        core = getattr(self, "_core", None)
+        if core is not None:
+            core.close()
+
+
+class LegacyServer(SimServer):
+    """The pre-core accept loop (one task per ``accept1``) — kept as an
+    A/B baseline; deprecated for serving."""
+
+    async def serve(self, addr: "str | tuple") -> None:
+        await _SimServer.serve(self, addr)
 
 
 Server = SimServer  # the natural real-mode name
@@ -56,6 +84,7 @@ __all__ = [
     "CompletedMultipartUpload",
     "CompletedPart",
     "Delete",
+    "LegacyServer",
     "ObjectIdentifier",
     "S3Error",
     "S3Service",

@@ -211,11 +211,23 @@ async def connect(addr: "str | Addr") -> Tuple[StreamSender, StreamReceiver]:
 
 
 class StreamListener:
-    """Accept-side of the framed transport — the ``accept1`` analogue."""
+    """Accept-side of the framed transport — the ``accept1`` analogue.
+
+    Closed-listener semantics: after :meth:`close`, ``accept1`` raises
+    ``ConnectionAbortedError`` (it must not block forever on a listener
+    that will never accept again), queued-but-unclaimed connections are
+    hard-dropped so their clients see a reset instead of hanging, and a
+    connection that races the close through the kernel backlog is
+    aborted on arrival.
+    """
+
+    #: queue sentinel: wakes accept1 blocked at close time
+    _CLOSED = (None, None, ("closed", 0))
 
     def __init__(self) -> None:
         self._server: Optional[asyncio.AbstractServer] = None
         self._local: Addr = ("0.0.0.0", 0)
+        self._closed = False
         self._pending: "asyncio.Queue[Tuple[StreamSender, StreamReceiver, Addr]]" = (
             asyncio.Queue()
         )
@@ -230,6 +242,11 @@ class StreamListener:
             # callback ran; don't let a TypeError drop the connection
             peer = (writer.get_extra_info("peername") or ("?", 0))[:2]
             tx, rx = _wrap(reader, writer)
+            if self._closed:
+                # raced the close through the kernel backlog: nobody
+                # will ever claim this connection — reset it now
+                rx.close()
+                return
             await self._pending.put((tx, rx, peer))
 
         self._server = await asyncio.start_server(on_accept, host, port)
@@ -240,16 +257,27 @@ class StreamListener:
         return self._local
 
     async def accept1(self) -> Tuple[StreamSender, StreamReceiver, Addr]:
-        return await self._pending.get()
+        if self._closed:
+            raise ConnectionAbortedError("listener closed")
+        item = await self._pending.get()
+        if item[0] is None:  # the close sentinel
+            self._pending.put_nowait(StreamListener._CLOSED)  # for siblings
+            raise ConnectionAbortedError("listener closed")
+        return item
 
     def close(self) -> None:
+        if self._closed:
+            return
+        self._closed = True
         if self._server is not None:
             self._server.close()
         # accepted-but-unclaimed connections would otherwise hang their
         # clients forever (no EOF, no reset) — drop them hard
         while not self._pending.empty():
             try:
-                _tx, rx, _peer = self._pending.get_nowait()
+                item = self._pending.get_nowait()
             except asyncio.QueueEmpty:  # pragma: no cover - raced drain
                 break
-            rx.close()
+            if item[0] is not None:
+                item[1].close()
+        self._pending.put_nowait(StreamListener._CLOSED)

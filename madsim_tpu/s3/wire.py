@@ -122,114 +122,70 @@ _REASON = {200: "OK", 204: "No Content", 400: "Bad Request",
            500: "Internal Server Error"}
 
 
-class WireServer:
-    """Serve an :class:`S3Service` over S3 REST on a real TCP port."""
+class S3Rest:
+    """The S3 REST engine: one parsed HTTP request in, one response out.
 
-    def __init__(self, service: Optional[S3Service] = None, telemetry=None):
+    Pure protocol meaning — no sockets, no buffering. Both servers (the
+    shared-core :class:`WireServer` and the thread-per-connection
+    :class:`LegacyWireServer`) dispatch through this one engine, which
+    is what makes their response bytes identical by construction.
+    ``clock_ms`` injects the timestamp source so the determinism leg can
+    feed a seeded clock instead of wall time.
+    """
+
+    def __init__(self, service: Optional[S3Service] = None, telemetry=None,
+                 clock_ms=None):
         self.service = service or S3Service()
         self.telemetry = telemetry
-        self.bound_addr: Optional[Tuple[str, int]] = None
-        self._server: Optional[asyncio.AbstractServer] = None
+        self.clock_ms = clock_ms or (lambda: int(_walltime.time() * 1000))
+        #: optional list of (request, clock_ms, (status, body, headers))
+        #: — the live-vs-replay transcript, like ``KafkaWire.recorder``
+        self.recorder = None
+        self._now = 0
 
-    async def serve(self, addr: "str | tuple") -> None:
-        host, port = addr if isinstance(addr, tuple) else addr.rsplit(":", 1)
-        self._server = await asyncio.start_server(self._conn, host, int(port))
-        self.bound_addr = self._server.sockets[0].getsockname()[:2]
-        async with self._server:
-            await self._server.serve_forever()
+    def handle(self, req) -> Tuple[int, bytes, Dict[str, str]]:
+        """Dispatch one request (any object with ``method``/``path``/
+        ``query``/``headers``/``body``) → ``(status, body, headers)``.
 
-    def close(self) -> None:
-        if self._server is not None:
-            self._server.close()
-
-    # -- HTTP/1.1 plumbing --------------------------------------------------
-
-    async def _conn(self, reader: asyncio.StreamReader,
-                    writer: asyncio.StreamWriter) -> None:
+        The clock is sampled exactly ONCE per request, up front — the
+        same purity contract as ``KafkaWire.handle_frame``: the response
+        is a pure function of (request, clock sample), which is what the
+        recorded transcript replays against a fresh engine."""
+        self._now = self.clock_ms()
+        t0 = (_walltime.perf_counter()
+              if self.telemetry is not None else 0.0)
+        try:
+            rsp = self._dispatch(req)
+        except S3Error as e:
+            rsp = _Response(
+                _ERROR_STATUS.get(e.code, 400),
+                _xml("Error",
+                     f"<Code>{_esc(e.code)}</Code>"
+                     f"<Message>{_esc(e.message)}</Message>"),
+            )
+        except Exception as e:  # noqa: BLE001 — wire boundary
+            rsp = _Response(
+                500,
+                _xml("Error",
+                     "<Code>InternalError</Code>"
+                     f"<Message>{_esc(str(e))}</Message>"),
+            )
         if self.telemetry is not None:
             self.telemetry.count(
-                "s3_connections_total", help="accepted connections"
+                "s3_requests_total", help="requests served",
+                method=req.method,
             )
-        try:
-            while True:
-                req = await self._read_request(reader)
-                if req is None:
-                    return
-                t0 = (_walltime.perf_counter()
-                      if self.telemetry is not None else 0.0)
-                try:
-                    rsp = self._dispatch(req)
-                except S3Error as e:
-                    rsp = _Response(
-                        _ERROR_STATUS.get(e.code, 400),
-                        _xml("Error",
-                             f"<Code>{_esc(e.code)}</Code>"
-                             f"<Message>{_esc(e.message)}</Message>"),
-                    )
-                except Exception as e:  # noqa: BLE001 — wire boundary
-                    rsp = _Response(
-                        500,
-                        _xml("Error",
-                             "<Code>InternalError</Code>"
-                             f"<Message>{_esc(str(e))}</Message>"),
-                    )
-                if self.telemetry is not None:
-                    self.telemetry.count(
-                        "s3_requests_total", help="requests served",
-                        method=req.method,
-                    )
-                    self.telemetry.observe(
-                        "s3_api_seconds",
-                        _walltime.perf_counter() - t0,
-                        help="per-request handling latency",
-                        method=req.method,
-                    )
-                await self._write_response(writer, req, rsp)
-        except (ConnectionError, asyncio.IncompleteReadError):
-            pass
-        finally:
-            writer.close()
-
-    @staticmethod
-    async def _read_request(reader: asyncio.StreamReader) -> Optional[_Request]:
-        try:
-            head = await reader.readuntil(b"\r\n\r\n")
-        except (asyncio.IncompleteReadError, ConnectionError):
-            return None
-        lines = head.decode("latin-1").split("\r\n")
-        method, target, _version = lines[0].split(" ", 2)
-        headers = {}
-        for line in lines[1:]:
-            if ":" in line:
-                k, _, v = line.partition(":")
-                headers[k.strip().lower()] = v.strip()
-        parsed = urllib.parse.urlsplit(target)
-        query = {
-            k: v[0] if v else ""
-            for k, v in urllib.parse.parse_qs(
-                parsed.query, keep_blank_values=True
-            ).items()
-        }
-        length = int(headers.get("content-length", "0"))
-        body = await reader.readexactly(length) if length else b""
-        return _Request(
-            method, urllib.parse.unquote(parsed.path), query, headers, body
-        )
-
-    @staticmethod
-    async def _write_response(writer: asyncio.StreamWriter, req: _Request,
-                              rsp: _Response) -> None:
-        head_only = req.method == "HEAD"
-        body = b"" if head_only else rsp.body
-        lines = [f"HTTP/1.1 {rsp.status} {_REASON.get(rsp.status, 'OK')}"]
-        headers = dict(rsp.headers)
-        # HEAD advertises the real entity length; the others, the sent one
-        headers["Content-Length"] = str(len(rsp.body))
-        headers.setdefault("Server", "madsim-s3-wire")
-        for k, v in headers.items():
-            lines.append(f"{k}: {v}")
-        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
-        await writer.drain()
+            self.telemetry.observe(
+                "s3_api_seconds",
+                _walltime.perf_counter() - t0,
+                help="per-request handling latency",
+                method=req.method,
+            )
+        if self.recorder is not None:
+            self.recorder.append(
+                (req, self._now, (rsp.status, rsp.body, dict(rsp.headers)))
+            )
+        return rsp.status, rsp.body, rsp.headers
 
     # -- the S3 operation map -----------------------------------------------
 
@@ -301,7 +257,7 @@ class WireServer:
 
     def _object_op(self, req: _Request, bucket: str, key: str) -> _Response:
         svc = self.service
-        now_ms = int(_walltime.time() * 1000)
+        now_ms = self._now  # the one per-request clock sample (handle())
         if req.method == "PUT" and "uploadId" in req.query:
             if "x-amz-copy-source" in req.headers:
                 # UploadPartCopy: the part body comes from an existing
@@ -394,3 +350,143 @@ class WireServer:
                 ),
             )
         raise S3Error("InvalidArgument", f"{req.method} /{bucket}/{key}")
+
+
+class WireServer:
+    """Serve an :class:`S3Service` over S3 REST on a real TCP port,
+    multiplexed by the shared serving core (``madsim_tpu/serve/``):
+    incremental HTTP parsing, bounded write queues, slow-client
+    eviction, and ``serve_*`` metrics come from the core; this class
+    owns only the S3 meaning via :class:`S3Rest`."""
+
+    def __init__(self, service: Optional[S3Service] = None, telemetry=None,
+                 clock_ms=None, shards: int = 1):
+        self.rest = S3Rest(service, telemetry=telemetry, clock_ms=clock_ms)
+        self.service = self.rest.service
+        self.telemetry = telemetry
+        self.bound_addr: Optional[Tuple[str, int]] = None
+        self._shards = shards
+        self._core = None
+        self.adapter = None  # set at start; carries the stall hook
+
+    def _count_conn(self, _conn) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "s3_connections_total", help="accepted connections"
+            )
+
+    async def start(self, addr: "str | tuple") -> None:
+        from ..serve import AsyncWireServer, HttpAdapter
+
+        self.adapter = HttpAdapter(
+            self.rest.handle, name="s3", connect_hook=self._count_conn
+        )
+        self._core = AsyncWireServer(
+            self.adapter, telemetry=self.telemetry, shards=self._shards
+        )
+        self.bound_addr = await self._core.start(addr)
+
+    async def serve(self, addr: "str | tuple") -> None:
+        await self.start(addr)
+        try:
+            await self._core._stopped.wait()
+        finally:
+            self._core._teardown()
+
+    def close(self) -> None:
+        if self._core is not None:
+            self._core.close()
+
+    async def aclose(self, drain_timeout: float = 5.0) -> None:
+        if self._core is not None:
+            await self._core.aclose(drain_timeout)
+
+
+class LegacyWireServer:
+    """The pre-core transport: one asyncio-streams task per connection,
+    unbounded write buffering. Kept as the A/B baseline for parity and
+    determinism gates; deprecated for serving — see docs/wire.md.
+    Dispatch goes through the same :class:`S3Rest` engine, so response
+    bytes match the core-backed server exactly."""
+
+    def __init__(self, service: Optional[S3Service] = None, telemetry=None,
+                 clock_ms=None):
+        self.rest = S3Rest(service, telemetry=telemetry, clock_ms=clock_ms)
+        self.service = self.rest.service
+        self.telemetry = telemetry
+        self.bound_addr: Optional[Tuple[str, int]] = None
+        self._server: Optional[asyncio.AbstractServer] = None
+
+    async def serve(self, addr: "str | tuple") -> None:
+        host, port = addr if isinstance(addr, tuple) else addr.rsplit(":", 1)
+        self._server = await asyncio.start_server(self._conn, host, int(port))
+        self.bound_addr = self._server.sockets[0].getsockname()[:2]
+        async with self._server:
+            await self._server.serve_forever()
+
+    def close(self) -> None:
+        if self._server is not None:
+            self._server.close()
+
+    # -- HTTP/1.1 plumbing --------------------------------------------------
+
+    async def _conn(self, reader: asyncio.StreamReader,
+                    writer: asyncio.StreamWriter) -> None:
+        if self.telemetry is not None:
+            self.telemetry.count(
+                "s3_connections_total", help="accepted connections"
+            )
+        try:
+            while True:
+                req = await self._read_request(reader)
+                if req is None:
+                    return
+                status, body, headers = self.rest.handle(req)
+                await self._write_response(
+                    writer, req, _Response(status, body, headers)
+                )
+        except (ConnectionError, asyncio.IncompleteReadError):
+            pass
+        finally:
+            writer.close()
+
+    @staticmethod
+    async def _read_request(reader: asyncio.StreamReader) -> Optional[_Request]:
+        try:
+            head = await reader.readuntil(b"\r\n\r\n")
+        except (asyncio.IncompleteReadError, ConnectionError):
+            return None
+        lines = head.decode("latin-1").split("\r\n")
+        method, target, _version = lines[0].split(" ", 2)
+        headers = {}
+        for line in lines[1:]:
+            if ":" in line:
+                k, _, v = line.partition(":")
+                headers[k.strip().lower()] = v.strip()
+        parsed = urllib.parse.urlsplit(target)
+        query = {
+            k: v[0] if v else ""
+            for k, v in urllib.parse.parse_qs(
+                parsed.query, keep_blank_values=True
+            ).items()
+        }
+        length = int(headers.get("content-length", "0"))
+        body = await reader.readexactly(length) if length else b""
+        return _Request(
+            method, urllib.parse.unquote(parsed.path), query, headers, body
+        )
+
+    @staticmethod
+    async def _write_response(writer: asyncio.StreamWriter, req: _Request,
+                              rsp: _Response) -> None:
+        head_only = req.method == "HEAD"
+        body = b"" if head_only else rsp.body
+        lines = [f"HTTP/1.1 {rsp.status} {_REASON.get(rsp.status, 'OK')}"]
+        headers = dict(rsp.headers)
+        # HEAD advertises the real entity length; the others, the sent one
+        headers["Content-Length"] = str(len(rsp.body))
+        headers.setdefault("Server", "madsim-s3-wire")
+        for k, v in headers.items():
+            lines.append(f"{k}: {v}")
+        writer.write(("\r\n".join(lines) + "\r\n\r\n").encode() + body)
+        await writer.drain()

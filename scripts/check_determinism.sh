@@ -19,6 +19,10 @@
 # A fleet leg runs the leased-unit orchestrator over a shared corpus
 # store with 1 and 2 workers, twice each, and byte-diffs the merged
 # report across all four runs (docs/fleet.md merge contract).
+# A serving-core leg runs the seeded wire_load determinism transcript
+# (kafka + S3 + framed etcd, injected clocks) across two processes x
+# {async core, legacy servers} x {telemetry on, off} and byte-diffs the
+# four reports (docs/wire.md "Async serving core" contract).
 set -euo pipefail
 cd "$(dirname "$0")/.."
 
@@ -269,6 +273,36 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     diff "$out/wa.json" "$out/wb.json" >&2 || true
     diff "$out/wfa.json" "$out/wfb.json" >&2 || true
     cat "$out"/w*.log >&2 || true
+    exit 1
+  fi
+
+  # serving-core leg (docs/wire.md "Async serving core"): the seeded
+  # sequential wire_load determinism report — per-wire response hashes
+  # over the kafka binary, S3 REST and framed etcd wires, with injected
+  # clocks and a pinned advertised address — must be byte-identical
+  # across two processes x {async core, legacy thread-per-connection}
+  # x {telemetry on, off}. One pinned byte string means the core is a
+  # transport change only, and its metrics are strictly out-of-band.
+  # Each run also asserts live-vs-replay transcript identity in-process
+  # (replay_ok gates its exit code).
+  "${PY:-python}" scripts/wire_load.py --determinism --server async \
+    --report "$out/sa.json" >"$out/sa.log" 2>&1 || true
+  "${PY:-python}" scripts/wire_load.py --determinism --server async \
+    --report "$out/sb.json" >"$out/sb.log" 2>&1 || true
+  "${PY:-python}" scripts/wire_load.py --determinism --server legacy \
+    --report "$out/sl.json" >"$out/sl.log" 2>&1 || true
+  "${PY:-python}" scripts/wire_load.py --determinism --server async \
+    --telemetry --report "$out/st.json" >"$out/st.log" 2>&1 || true
+  if [ -s "$out/sa.json" ] && cmp -s "$out/sa.json" "$out/sb.json" \
+    && cmp -s "$out/sa.json" "$out/sl.json" \
+    && cmp -s "$out/sa.json" "$out/st.json"; then
+    echo "determinism gate: OK (serving core, 2 processes x 2 servers x telemetry on/off, byte-identical)"
+  else
+    echo "determinism gate: FAILED — serving-core wire reports differ or are empty" >&2
+    diff "$out/sa.json" "$out/sb.json" >&2 || true
+    diff "$out/sa.json" "$out/sl.json" >&2 || true
+    diff "$out/sa.json" "$out/st.json" >&2 || true
+    cat "$out"/s?.log >&2 || true
     exit 1
   fi
 

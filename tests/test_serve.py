@@ -1,0 +1,463 @@
+"""The async serving core (madsim_tpu/serve): framing reassembly,
+bounded-queue backpressure, lifecycle, and adapter parity.
+
+The heavy end-to-end rig (>=1k concurrent clients, chaos mid-run) is
+``scripts/wire_load.py`` / `make wire-smoke`; these tests pin the core's
+unit contracts cheaply: framers are pure state machines, ``Conn`` is
+driven through a fake transport (no sockets, no sleeps), and the parity
+test replays a small seeded client mix against both the core-backed and
+the legacy thread-of-control Kafka servers and byte-compares the
+recorded transcripts.
+"""
+
+import asyncio
+import random
+import struct
+import subprocess
+import sys
+import os
+
+import pytest
+
+from madsim_tpu.obs import Telemetry
+from madsim_tpu.oracle import History, Op, S3Spec, check_history
+from madsim_tpu.oracle.history import OP_DEL, OP_GET, OP_PUT
+from madsim_tpu.oracle.specs import ABSENT
+from madsim_tpu.serve import (
+    AsyncWireServer,
+    FramingError,
+    PureFrameAdapter,
+    WireAdapter,
+)
+from madsim_tpu.serve.framing import (
+    HttpRequestFramer,
+    LengthPrefixFramer,
+    frame,
+    render_http_response,
+)
+
+
+# -- framing: reassembly across arbitrary chunk boundaries -------------------
+
+
+def test_length_prefix_reassembly_byte_by_byte():
+    bodies = [b"", b"x", b"hello" * 100, bytes(range(256))]
+    wire = b"".join(frame(b) for b in bodies)
+    f = LengthPrefixFramer()
+    out = []
+    for i in range(len(wire)):
+        out.extend(f.feed(wire[i : i + 1]))
+    assert out == bodies
+    assert f.pending() == 0
+
+    # and the whole stream in one chunk
+    f2 = LengthPrefixFramer()
+    assert f2.feed(wire) == bodies
+
+
+def test_length_prefix_rejects_insane_length():
+    f = LengthPrefixFramer(max_frame=16)
+    with pytest.raises(FramingError):
+        f.feed(struct.pack(">I", 17) + b"x" * 17)
+
+
+def test_http_framer_split_boundaries_and_keepalive():
+    put = (
+        b"PUT /b/k?uploadId=u-1&partNumber=2 HTTP/1.1\r\n"
+        b"Content-Length: 11\r\nHost: x\r\n\r\nhello world"
+    )
+    get = b"GET /b/k HTTP/1.1\r\nContent-Length: 0\r\n\r\n"
+    wire = put + get  # keep-alive: two requests on one stream
+    # split at every position: the parse must come out identical
+    for cut in range(0, len(wire), 7):
+        f = HttpRequestFramer()
+        reqs = f.feed(wire[:cut]) + f.feed(wire[cut:])
+        assert [r.method for r in reqs] == ["PUT", "GET"]
+        assert reqs[0].path == "/b/k"
+        assert reqs[0].query == {"uploadId": "u-1", "partNumber": "2"}
+        assert reqs[0].headers["content-length"] == "11"
+        assert reqs[0].body == b"hello world"
+        assert reqs[1].body == b""
+        assert f.pending() == 0
+
+
+def test_http_framer_rejects_garbage():
+    with pytest.raises(FramingError):
+        HttpRequestFramer().feed(b"NOTHTTP\r\n\r\n")
+    with pytest.raises(FramingError):
+        HttpRequestFramer().feed(
+            b"PUT /k HTTP/1.1\r\nContent-Length: nope\r\n\r\n"
+        )
+    with pytest.raises(FramingError):
+        HttpRequestFramer(max_body=8).feed(
+            b"PUT /k HTTP/1.1\r\nContent-Length: 9\r\n\r\n"
+        )
+
+
+def test_render_http_response_head_advertises_but_omits_body():
+    full = render_http_response(200, b"body!", {"ETag": '"e"'})
+    head = render_http_response(200, b"body!", {"ETag": '"e"'},
+                                head_only=True)
+    assert full.endswith(b"body!")
+    assert not head.endswith(b"body!")
+    assert b"Content-Length: 5" in head  # real entity length, no body
+
+
+# -- Conn: bounded write queue + pause bookkeeping (fake transport) ----------
+
+
+class FakeTransport:
+    def __init__(self):
+        self.written = bytearray()
+        self.reading = True
+        self.closed = False
+        self.aborted = False
+
+    def get_extra_info(self, _key):
+        return ("test-peer", 0)
+
+    def write(self, data):
+        self.written += data
+
+    def pause_reading(self):
+        self.reading = False
+
+    def resume_reading(self):
+        self.reading = True
+
+    def close(self):
+        self.closed = True
+
+    def abort(self):
+        self.aborted = True
+
+
+def _proto_on_fake(telemetry=None, **srv_kw):
+    from madsim_tpu.serve.core import _WireProtocol
+
+    srv = AsyncWireServer(
+        PureFrameAdapter(lambda b: b, name="t"),
+        telemetry=telemetry, **srv_kw,
+    )
+    proto = _WireProtocol(srv, asyncio.get_running_loop())
+    t = FakeTransport()
+    proto.connection_made(t)
+    return srv, proto, t
+
+
+def test_conn_backpressure_pause_resume_and_drain():
+    async def main():
+        tel = Telemetry()
+        srv, proto, t = _proto_on_fake(
+            telemetry=tel, max_queue_bytes=200, read_pause_bytes=100
+        )
+        conn = proto.conn
+
+        # writable transport: send writes straight through, no queue
+        conn.send(b"a" * 10)
+        assert bytes(t.written) == b"a" * 10 and not conn._q
+
+        # transport pushes back: output queues; crossing read_pause_bytes
+        # pauses the read side (write-backlog backpressure)
+        proto.pause_writing()
+        conn.send(b"b" * 60)
+        assert t.reading and conn._q_bytes == 60
+        conn.send(b"c" * 60)
+        assert not t.reading  # 120 > read_pause_bytes
+        assert tel.registry.get(
+            "serve_backpressure_pauses_total", wire="t") == 1
+
+        # drained() blocks until the transport resumes and we flush
+        waiter = asyncio.ensure_future(conn.drained())
+        await asyncio.sleep(0)
+        assert not waiter.done()
+        proto.resume_writing()
+        await asyncio.wait_for(waiter, 1)
+        assert bytes(t.written) == b"a" * 10 + b"b" * 60 + b"c" * 60
+        assert t.reading and conn._q_bytes == 0
+        assert srv.open_conns() == 1
+    asyncio.run(main())
+
+
+def test_conn_slow_client_evicted_at_queue_bound():
+    async def main():
+        tel = Telemetry()
+        srv, proto, t = _proto_on_fake(
+            telemetry=tel, max_queue_bytes=200, read_pause_bytes=100
+        )
+        conn = proto.conn
+        proto.pause_writing()
+        conn.send(b"x" * 150)
+        assert not t.aborted
+        conn.send(b"y" * 100)  # 250 > max_queue_bytes: evict, hard
+        assert t.aborted
+        assert tel.registry.get(
+            "serve_slow_client_drops_total", wire="t") == 1
+
+        proto.connection_lost(None)
+        assert conn.closed and srv.open_conns() == 0
+        with pytest.raises(BrokenPipeError):
+            conn.send(b"late")
+    asyncio.run(main())
+
+
+def test_protocol_violation_aborts_connection():
+    async def main():
+        _srv, proto, t = _proto_on_fake()
+        proto.data_received(struct.pack(">I", 0xFFFF_FFFF))
+        assert t.aborted  # FramingError: dropped like a real wire
+    asyncio.run(main())
+
+
+def test_close_defers_until_queue_flushes():
+    async def main():
+        _srv, proto, t = _proto_on_fake()
+        conn = proto.conn
+        proto.pause_writing()
+        conn.send(b"tail")
+        conn.close()
+        assert not t.closed  # queued output must reach the peer first
+        proto.resume_writing()
+        assert t.closed and bytes(t.written) == b"tail"
+    asyncio.run(main())
+
+
+# -- clean shutdown with in-flight async handlers (real sockets) -------------
+
+
+class _SlowEcho(WireAdapter):
+    """Answers each frame from a coroutine after a short sleep — the
+    in-flight shape ``aclose`` must drain, in arrival order."""
+
+    name = "slowecho"
+
+    def new_framer(self):
+        return LengthPrefixFramer()
+
+    def on_frame(self, conn, body):
+        async def run():
+            await asyncio.sleep(0.02)
+            return frame(b"echo:" + body)
+
+        return run()
+
+
+def test_aclose_drains_inflight_async_handlers_in_order():
+    async def main():
+        srv = AsyncWireServer(_SlowEcho())
+        host, port = await srv.start(("127.0.0.1", 0))
+        reader, writer = await asyncio.open_connection(host, port)
+        writer.write(frame(b"one") + frame(b"two"))
+        await writer.drain()
+        await asyncio.sleep(0.005)  # let the frames reach the server
+        assert srv._inflight > 0  # handlers genuinely in flight
+        await srv.aclose(drain_timeout=2.0)
+        # both responses arrive, in order, then a clean EOF
+        got = await asyncio.wait_for(reader.read(), 2)
+        f = LengthPrefixFramer()
+        assert f.feed(got) == [b"echo:one", b"echo:two"]
+        writer.close()
+    asyncio.run(main())
+
+
+def test_inject_read_stall_blackholes_matched_conns_only():
+    async def main():
+        tel = Telemetry()
+        srv = AsyncWireServer(PureFrameAdapter(lambda b: b, name="t"),
+                              telemetry=tel)
+        host, port = await srv.start(("127.0.0.1", 0))
+        r1, w1 = await asyncio.open_connection(host, port)
+        r2, w2 = await asyncio.open_connection(host, port)
+        while srv.open_conns() < 2:
+            await asyncio.sleep(0.001)
+        ids = sorted(c.id for c in srv.connections())
+        n = srv.inject_read_stall(0.05, match=lambda c: c.id == ids[0])
+        assert n == 1
+        assert tel.registry.get("serve_chaos_stalls_total", wire="t") == 1
+        # the unmatched connection keeps round-tripping during the stall
+        w2.write(frame(b"live"))
+        assert (await asyncio.wait_for(r2.readexactly(8), 1))[4:] == b"live"
+        # the stalled one answers only after the heal timer fires
+        w1.write(frame(b"held"))
+        read1 = asyncio.ensure_future(r1.readexactly(8))
+        done, _ = await asyncio.wait([read1], timeout=0.02)
+        assert not done  # blackholed while stalled
+        assert (await asyncio.wait_for(read1, 1))[4:] == b"held"
+        for w in (w1, w2):
+            w.close()
+        srv.close()
+    asyncio.run(main())
+
+
+# -- adapter parity: core-backed vs legacy servers, one seeded transcript ----
+
+
+class _CounterClock:
+    def __init__(self, start=1_000_000):
+        self.t = start
+
+    def __call__(self):
+        self.t += 1
+        return self.t
+
+
+async def _kafka_transcript(server):
+    """A small seeded probe mix; returns the server's recorded
+    (request bytes, clock, response bytes) transcript."""
+    from madsim_tpu.kafka.probe import ProbeClient, RealTransport
+
+    await server.start(("127.0.0.1", 0))
+    server.wire.recorder = []
+    rng = random.Random(7)
+    c = ProbeClient(await RealTransport.connect(server.bound_addr))
+    try:
+        await c.api_versions()
+        await c.create_topics([("p", 2)])
+        offsets = [0, 0]
+        for _ in range(12):
+            part = rng.randrange(2)
+            if rng.randrange(2):
+                await c.produce("p", part,
+                                [(1_000, b"k", b"v%d" % rng.randrange(99))])
+            else:
+                err, _hi, rows = await c.fetch("p", part, offsets[part])
+                if not err and rows:
+                    offsets[part] = rows[-1][0] + 1
+    finally:
+        c.close()
+        server.close()
+    return server.wire.recorder
+
+
+def test_kafka_adapter_parity_async_vs_legacy():
+    """The serving core is a transport change, not a protocol change:
+    with the clock injected and the advertised address pinned, the
+    core-backed server and the legacy task-per-connection server record
+    byte-identical transcripts for the same seeded client mix."""
+    from madsim_tpu.kafka.wire import LegacyWireServer, WireServer
+
+    adv = ("127.0.0.1", 9092)
+
+    async def run_async():
+        return await _kafka_transcript(
+            WireServer(clock_ms=_CounterClock(), advertised=adv))
+
+    async def run_legacy():
+        return await _kafka_transcript(
+            LegacyWireServer(clock_ms=_CounterClock(), advertised=adv))
+
+    a = asyncio.run(run_async())
+    b = asyncio.run(run_legacy())
+    assert len(a) == len(b) >= 14
+    assert a == b
+
+
+# -- channel adapter: the pull-style (tx, rx) surface over the core ----------
+
+
+def test_channel_adapter_runs_pull_style_handler():
+    from madsim_tpu.real import codec
+    from madsim_tpu.serve import ChannelAdapter
+    from madsim_tpu.real import stream
+
+    async def upper(tx, rx):
+        while True:
+            msg = await rx.recv()
+            if msg is None:
+                break
+            await tx.send(str(msg).upper())
+        tx.close()
+
+    async def main():
+        srv = AsyncWireServer(ChannelAdapter(upper, codec))
+        addr = await srv.start(("127.0.0.1", 0))
+        tx, rx = await stream.connect(addr)
+        await tx.send("hello")
+        assert await rx.recv() == "HELLO"
+        await tx.send("again")
+        assert await rx.recv() == "AGAIN"
+        tx.close()
+        assert await rx.recv() is None  # handler EOF propagates cleanly
+        srv.close()
+    asyncio.run(main())
+
+
+# -- real/stream: closed-listener semantics the load rig leans on ------------
+
+
+def test_stream_listener_close_drops_unclaimed_connections():
+    from madsim_tpu.real import stream
+
+    async def main():
+        lis = await stream.StreamListener.bind(("127.0.0.1", 0))
+        addr = lis.local_addr()
+        # queued-but-unclaimed: accepted by the kernel, never accept1()d
+        tx, rx = await stream.connect(addr)
+        await asyncio.sleep(0.02)  # let the accept callback enqueue it
+        lis.close()
+        # the unclaimed client sees a reset/EOF instead of hanging
+        with pytest.raises((ConnectionResetError, ConnectionError)):
+            if await rx.recv() is None:
+                raise ConnectionResetError("clean EOF counts as dropped")
+        # and accept1 on a closed listener raises instead of blocking
+        with pytest.raises(ConnectionAbortedError):
+            await lis.accept1()
+        tx.close()
+    asyncio.run(main())
+
+
+# -- S3Spec: the per-object register semantics the rig checks against --------
+
+
+def _s3_hist(*ops):
+    return History(seed=0, ops=tuple(ops), overflow=False,
+                   rows=2 * len(ops))
+
+
+def test_s3_spec_register_semantics():
+    v1, v2 = 101, 202
+    legal = _s3_hist(
+        Op(0, OP_PUT, 5, v1, 0, 0, 1, 0),
+        Op(1, OP_GET, 5, 0, v1, 2, 3, 0),
+        Op(0, OP_PUT, 5, v2, 0, 4, 5, 1),
+        Op(1, OP_GET, 5, 0, v2, 6, 7, 1),
+        Op(0, OP_DEL, 5, 0, 0, 8, 9, 2),
+        Op(1, OP_GET, 5, 0, ABSENT, 10, 11, 2),
+    )
+    assert check_history(legal, S3Spec()).ok
+
+    # a lost PUT: the GET observes absence with no DELETE in between
+    torn = _s3_hist(
+        Op(0, OP_PUT, 5, v1, 0, 0, 1, 0),
+        Op(1, OP_GET, 5, 0, ABSENT, 2, 3, 0),
+    )
+    r = check_history(torn, S3Spec())
+    assert not r.ok
+
+    # keys are independent partitions: a stale read on one key cannot
+    # be excused by activity on another
+    cross = _s3_hist(
+        Op(0, OP_PUT, 5, v1, 0, 0, 1, 0),
+        Op(0, OP_PUT, 6, v2, 0, 2, 3, 1),
+        Op(1, OP_GET, 5, 0, v2, 4, 5, 0),
+    )
+    assert not check_history(cross, S3Spec()).ok
+    assert S3Spec().partition_of(Op(1, OP_GET, 6, 0, 0, 0, 1, 0)) == 6
+
+
+# -- the whole rig, small (slow: `make wire-smoke` drills this) --------------
+
+
+@pytest.mark.slow
+def test_wire_load_smoke_end_to_end():
+    """SMOKE_SCENARIO through the load rig: concurrent worker processes,
+    oracle-checked histories, live-vs-replay identity, async-vs-legacy
+    parity — the subprocess keeps the forked workers jax-free."""
+    script = os.path.join(os.path.dirname(__file__), "..", "scripts",
+                          "wire_load.py")
+    proc = subprocess.run(
+        [sys.executable, script, "--smoke"],
+        capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "smoke parity [async vs legacy" in proc.stdout
