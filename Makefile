@@ -46,6 +46,12 @@
 #                      solo bytes, strictly more fingerprints than
 #                      either worker alone, kill -9 mid-append + lease
 #                      reclaim, regression-replay gate
+#   make steer-smoke   self-steering scheduler (docs/steering.md):
+#                      bandit campaign report + decision trace replayed
+#                      byte-identical (telemetry on/off), journaled
+#                      steer_round mirror, and the adaptive-vs-uniform
+#                      A/B at a matched device-event budget (>= 1.5x
+#                      distinct fingerprints)
 #   make stest         sim suite + determinism smoke gate (a fault-campaign
 #                      sweep twice in two processes, traces byte-diffed;
 #                      plus two campaign runs, JSONL reports byte-diffed;
@@ -69,8 +75,8 @@ PYTEST_ARGS ?=
 
 .PHONY: test test-nonative test-real test-procs stest determinism \
 	explore-smoke oracle-smoke differential-smoke wire-smoke \
-	multichip-smoke stream-smoke obs-smoke fleet-smoke dryrun \
-	bench-smoke test-all
+	multichip-smoke stream-smoke obs-smoke fleet-smoke steer-smoke \
+	dryrun bench-smoke test-all
 
 test:
 	$(PYTEST) tests/ -q $(PYTEST_ARGS)
@@ -137,8 +143,16 @@ obs-smoke:
 fleet-smoke:
 	JAX_PLATFORMS=cpu $(PY) scripts/fleet_smoke.py
 
+# the self-steering scheduler (docs/steering.md): replayed bandit
+# campaign byte-identity (report + decision trace, telemetry on/off),
+# the journal's steer_round mirror, and the matched-budget
+# adaptive-vs-uniform fingerprint A/B
+steer-smoke:
+	JAX_PLATFORMS=cpu $(PY) scripts/steer_demo.py
+
 stest: test determinism explore-smoke oracle-smoke differential-smoke \
-	wire-smoke multichip-smoke stream-smoke obs-smoke fleet-smoke
+	wire-smoke multichip-smoke stream-smoke obs-smoke fleet-smoke \
+	steer-smoke
 
 test-nonative:
 	MADSIM_NO_NATIVE=1 $(PYTEST) tests/ -q $(PYTEST_ARGS)

@@ -119,6 +119,23 @@ TELEM_CHUNK = 1024
 TELEM_REPS = 3
 TELEM_SIM_SECONDS = 2.0
 TELEM_OVERHEAD_GATE = 0.03
+# steering leg (the self-steering scheduler A/B, docs/steering.md
+# "What the A/B measures"): bandit vs uniform at the SAME deterministic
+# device-event budget on two targets — the raft amnesia gate (10
+# families, 2 crash-bearing: the uniform grid burns ~80% of its budget
+# on amnesia-blind duds) and the partitioned stale-read etcd gate (its
+# single reachable fingerprint saturates both policies, so its win
+# metric is coverage bits, not fingerprints). One rep per cell: the
+# figure of merit is fingerprints-at-matched-budget, a deterministic
+# count, not a wall-clock rate (wall is reported for context only)
+STEER_FAMILIES = (0x001, 0x002, 0x003, 0x004, 0x008,
+                  0x010, 0x020, 0x040, 0x080, 0x100)
+STEER_SEEDS_PER_ROUND = 16
+STEER_ESCALATE_SEEDS = 8
+STEER_KILL_PLAYS = 1
+STEER_CAMPAIGN_SEED = 7
+STEER_RAFT_BUDGET = 45_000
+STEER_ETCD_BUDGET = 12_000
 # wire-load leg (the serve/ async core under >=1k genuine-protocol
 # clients; docs/wire.md "Async serving core"): one full-scale run for
 # the SLO/oracle/replay gates + WIRE_REPS smaller reps for the
@@ -907,6 +924,92 @@ def bench_carryover() -> dict:
     }
 
 
+def bench_steering() -> dict:
+    """The self-steering scheduler A/B (``--steering``): bandit vs
+    uniform family allocation at a MATCHED deterministic device-event
+    budget, per target. Both policies run the same loop (run_steered),
+    same families, same seeds-per-round, same campaign seed — the only
+    difference is the pick rule (UCB + kill/escalate vs round-robin),
+    so every delta is attributable to allocation. Per cell: distinct
+    triage fingerprints (the acceptance metric — bandit/uniform >= 1.5x
+    on the raft gate), covered coverage bits, events spent until the
+    first violating candidate (the time-to-first-bug analogue in the
+    budget currency — deterministic, unlike wall), decision count, and
+    wall seconds for context. The etcd cell runs its checker-backed
+    triage (history=True) and is EXPECTED to tie on fingerprints: one
+    reachable flavor saturates both policies, and its delta shows up in
+    coverage bits instead — reported, not gated."""
+    from madsim_tpu.explore import CampaignConfig, SteerConfig, run_steered
+    from madsim_tpu.explore.targets import etcd_steer_gate, steer_gate
+
+    def cell(target, base, policy, budget, history):
+        ccfg = CampaignConfig(
+            rounds=999, seeds_per_round=STEER_SEEDS_PER_ROUND,
+            campaign_seed=STEER_CAMPAIGN_SEED, max_recorded_seeds=8,
+            scheduler=policy,
+        )
+        scfg = SteerConfig(
+            scheduler=policy, families=STEER_FAMILIES,
+            escalate_seeds=STEER_ESCALATE_SEEDS,
+            kill_plays=STEER_KILL_PLAYS, budget_events=budget,
+        )
+        t0 = walltime.perf_counter()
+        res = run_steered(target, base, ccfg, scfg, history=history)
+        wall = walltime.perf_counter() - t0
+        events_to_first_bug = None
+        spent = 0
+        for r in res.records:
+            spent += r.get("events_total", 0)
+            if r.get("violations", 0) > 0:
+                events_to_first_bug = spent
+                break
+        kinds = [d["kind"] for d in res.decisions]
+        return {
+            "fingerprints": len(res.fingerprints),
+            "fingerprint_list": res.fingerprints,
+            "coverage_bits": sum(int(w).bit_count() for w in res.coverage_map),
+            "events_to_first_bug": events_to_first_bug,
+            "spent_events": res.spent_events,
+            "decisions": kinds.count("decide"),
+            "kills": kinds.count("kill"),
+            "escalations": kinds.count("escalate"),
+            "wall_s": round(wall, 2),
+        }
+
+    def ab(name, target, base, budget, history):
+        bandit = cell(target, base, "bandit", budget, history)
+        uniform = cell(target, base, "uniform", budget, history)
+        ratio = (
+            round(bandit["fingerprints"] / uniform["fingerprints"], 2)
+            if uniform["fingerprints"] else None
+        )
+        return {
+            "target": name,
+            "budget_events": budget,
+            "bandit": bandit,
+            "uniform": uniform,
+            "fingerprint_ratio": ratio,
+            "coverage_ratio": round(
+                bandit["coverage_bits"] / uniform["coverage_bits"], 2
+            ) if uniform["coverage_bits"] else None,
+        }
+
+    rt, rb = steer_gate(smoke=True)
+    et, eb = etcd_steer_gate(smoke=True)
+    raft = ab("raft-amnesia", rt, rb, STEER_RAFT_BUDGET, False)
+    etcd = ab("etcd-stale", et, eb, STEER_ETCD_BUDGET, True)
+    return {
+        "families": len(STEER_FAMILIES),
+        "seeds_per_round": STEER_SEEDS_PER_ROUND,
+        "campaign_seed": STEER_CAMPAIGN_SEED,
+        "raft": raft,
+        "etcd": etcd,
+        # the acceptance gate rides on the raft cell; etcd saturates
+        "ratio_ok": (raft["fingerprint_ratio"] or 0) >= 1.5,
+        "backend": jax.default_backend(),
+    }
+
+
 def bench_wire_load() -> dict:
     """The async serving core under production-scale load
     (``--wire-load``): >=1k concurrent genuine-protocol clients (Kafka
@@ -1145,6 +1248,11 @@ if __name__ == "__main__":
         # unchecked twin; the <=2x checked_over_unchecked acceptance
         # figure at CHECKED_TOTAL seeds)
         print(json.dumps({"metric": "checked_leg", **bench_checked_sweep()}))
+    elif "--steering" in sys.argv:
+        # the steering A/B standalone (bandit vs uniform at a matched
+        # device-event budget; the >=1.5x fingerprint acceptance figure
+        # on the raft gate, coverage-bit delta on the saturated etcd one)
+        print(json.dumps({"metric": "steering_leg", **bench_steering()}))
     elif "--wire-load" in sys.argv:
         # the async-core serving leg standalone (>=1k-client SLO gate,
         # docs/wire.md; histories + replay checked in the subprocess)

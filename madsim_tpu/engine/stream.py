@@ -248,6 +248,7 @@ def stream_sweep(
     stop_after_rounds: Optional[int] = None,
     resume_from: Optional[str] = None,
     feed: Optional[Callable[[], Optional[dict]]] = None,
+    reprioritize: Optional[Callable] = None,
     telemetry=None,
 ) -> dict:
     """Sweep ``seeds`` through a constant-occupancy lane pool; returns
@@ -310,6 +311,18 @@ def stream_sweep(
     ``queue_order`` and with checkpointing (``ckpt_path``/
     ``resume_from``): the queue is open-ended, so there is no fixed
     submission order to permute or fingerprint.
+
+    Live queue reorder: ``reprioritize`` is a callable polled before
+    each dispatch with the UNDISPATCHED item indices (submission
+    order positions); it returns a permutation of that array (or None
+    to keep it) which replaces the dispatch order of the queued tail —
+    the explore scheduler's zero-recompile "jump the queue" knob
+    (explore/steer.py). Already-dispatched lanes and the initial pool
+    fill are untouched, and because results flush as virtual chunks in
+    SUBMISSION order regardless of dispatch order, a reprioritized
+    stream changes wall-clock only, never a report byte (the same
+    invariance ``queue_order`` pins). Incompatible with checkpointing:
+    a mutable dispatch order has no stable ``order_sha`` to fingerprint.
     """
     import time as _time
 
@@ -369,6 +382,13 @@ def stream_sweep(
                 f"with feed, the initial seeds must be a multiple of "
                 f"chunk_size={chunk_size}, got {n}"
             )
+    if reprioritize is not None and (
+        resume_from is not None or ckpt_path is not None
+    ):
+        raise ValueError(
+            "reprioritize is incompatible with checkpointing "
+            "(ckpt_path/resume_from): the dispatch order is mutable"
+        )
     params_host = (
         None if params is None else jax.tree.map(np.asarray, params)
     )
@@ -728,6 +748,22 @@ def stream_sweep(
         where a fleet worker's newly leased batches enter the running
         pool, mid-flight."""
         nonlocal next_q, refills, state
+        if reprioritize is not None and next_q < n:
+            # the live reorder: hand the scheduler the undispatched
+            # tail, let it permute the DISPATCH order only (results
+            # still flush in submission order — bytes cannot move)
+            tail = order[next_q:].copy()
+            new = reprioritize(tail)
+            if new is not None:
+                new = np.asarray(new, np.int64)
+                if new.shape != tail.shape or not np.array_equal(
+                    np.sort(new), np.sort(tail)
+                ):
+                    raise ValueError(
+                        "reprioritize must return a permutation of the "
+                        "undispatched item indices it was given"
+                    )
+                order[next_q:] = new
         while True:
             free = np.nonzero(lane_item < 0)[0]
             if free.size == 0:

@@ -29,6 +29,13 @@ Testing*):
   quarantine, expiring leases) and the leased-unit worker loop feeding
   ``stream_sweep`` in flight, with the regression-replay gate that
   keeps every stored bug reproducing forever (``docs/fleet.md``).
+- ``steer`` — the self-steering scheduler (``docs/steering.md``):
+  candidate families (mutation lineage + fault-category bitmask), a
+  deterministic UCB bandit allocating device-seconds by
+  novel-coverage-bits-per-event, early-kill of dedup-saturated
+  families, budget escalation near a first violation — with every
+  decision journaled and byte-reproducible
+  (``CampaignConfig.scheduler="bandit"``).
 - ``differential`` — host↔device differential validation: run the
   device raft model and ``examples/raft_host.py`` over matched
   ``(spec, seed)`` grids (one compiled fault schedule drives both
@@ -58,6 +65,18 @@ from .orchestrator import (  # noqa: F401
     run_worker,
     write_merged,
 )
+from .steer import (  # noqa: F401
+    BanditScheduler,
+    SteerConfig,
+    SteerResult,
+    family_candidate,
+    family_key,
+    family_of,
+    family_universe,
+    fold_family_stats,
+    plan_unit_steered,
+    run_steered,
+)
 from .store import CorpusStore, Lease, ReadStats  # noqa: F401
 from .differential import (  # noqa: F401
     DifferentialConfig,
@@ -69,7 +88,13 @@ from .differential import (  # noqa: F401
     run_differential,
 )
 from .shrink import ShrinkResult, narrow_windows, shrink  # noqa: F401
-from .targets import Target, amnesia_raft_target, stale_etcd_target  # noqa: F401
+from .targets import (  # noqa: F401
+    Target,
+    amnesia_raft_target,
+    etcd_steer_gate,
+    stale_etcd_target,
+    steer_gate,
+)
 from .triage import (  # noqa: F401
     HISTORY_FLAVOR,
     Failure,

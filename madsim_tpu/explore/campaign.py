@@ -113,6 +113,12 @@ class CampaignConfig(NamedTuple):
     # deterministic) campaign trajectory; batch=1 is the exact serial
     # semantics the byte-identity gates pin
     batch: int = 1
+    # compute-allocation policy: "uniform" is this module's classic
+    # corpus loop; "bandit" routes run_campaign through the
+    # self-steering scheduler (explore/steer.py, docs/steering.md) —
+    # family-partitioned candidates, UCB allocation, early-kill,
+    # budget escalation, and a journaled deterministic decision trace
+    scheduler: str = "uniform"
 
 
 class CampaignResult(NamedTuple):
@@ -444,6 +450,9 @@ def run_campaign(
     mesh=None,
     on_chunk=None,
     telemetry=None,
+    steer_cfg=None,
+    trace_path: Optional[str] = None,
+    history: bool = False,
 ) -> CampaignResult:
     """Drive the find loop: ``rounds`` candidates from ``base_spec``.
 
@@ -487,8 +496,30 @@ def run_campaign(
     failure counters (the dedup hit rate), time-to-first-bug, and one
     journal record per round. Strictly OUT-OF-BAND — the JSONL report
     bytes are identical with telemetry on or off (the determinism gate
-    runs both ways)."""
+    runs both ways).
+
+    ``ccfg.scheduler="bandit"`` hands the whole loop to the
+    self-steering scheduler (``explore.steer.run_steered``,
+    docs/steering.md): family-partitioned candidates, UCB compute
+    allocation, early-kill and budget escalation, with the decision
+    trace written to ``trace_path`` (deterministic bytes) and mirrored
+    into the journal as ``steer_round`` events. ``steer_cfg`` (a
+    ``steer.SteerConfig``) tunes the policy, ``history=True`` routes
+    the steered loop's in-flight triage through the history oracle
+    (required for targets whose violations only the WGL checker sees);
+    ``ckpt_dir``/``on_chunk`` apply to the classic uniform loop only."""
     import time as _time
+
+    if ccfg.scheduler not in ("uniform", "bandit"):
+        raise ValueError(f"unknown scheduler {ccfg.scheduler!r}")
+    if ccfg.scheduler == "bandit":
+        from .steer import run_steered
+
+        return run_steered(
+            target, base_spec, ccfg, steer_cfg, history=history,
+            report_path=report_path, trace_path=trace_path,
+            mesh=mesh, telemetry=telemetry,
+        ).campaign_result()
 
     rng = random.Random(ccfg.campaign_seed)
     corpus: List[object] = []

@@ -44,6 +44,7 @@ from __future__ import annotations
 import hashlib
 import json
 import random
+import time
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -71,7 +72,15 @@ def plan_unit(base_spec, ccfg: CampaignConfig, unit: int) -> List[object]:
     """Unit ``unit``'s candidates: ``ccfg.batch`` specs chained by
     mutation from ``base_spec`` under a unit-local rng — any process
     regenerates any unit identically, independent of every other unit.
-    Unit 0 leads with the unmutated base (the campaign's round 0)."""
+    Unit 0 leads with the unmutated base (the campaign's round 0).
+
+    This is the UNIFORM plan. With ``ccfg.scheduler="bandit"`` the
+    worker loop plans through ``steer.plan_unit_steered`` instead: the
+    unit's candidates come from a bandit primed with the merged store's
+    per-family stats over COMPLETED planning generations (leasing is
+    generation-gated below), so the plan stays a pure function any
+    worker computes identically — adaptive, without giving up the
+    partition invariance this function's unit-locality buys."""
     rng = random.Random(f"fleet:{ccfg.campaign_seed}:{unit}")
     k = max(1, ccfg.batch)
     specs: List[object] = []
@@ -130,6 +139,7 @@ def run_worker(
     max_units: Optional[int] = None,
     skip_gate: bool = False,
     telemetry=None,
+    steer_cfg=None,
     _crash_after_units: Optional[int] = None,
 ) -> dict:
     """One fleet worker: lease units, stream them, triage+shrink, store.
@@ -146,8 +156,28 @@ def run_worker(
     drill: after storing that many units the process dies by
     ``os._exit`` mid-append, leaving a torn record and an unrenewed
     lease behind for a peer to quarantine/reclaim.
+
+    ``ccfg.scheduler="bandit"`` turns on steered planning
+    (docs/steering.md): units group into generations of
+    ``steer_cfg.gen_units``, a unit only becomes leasable once every
+    unit of all earlier generations is DONE, and its candidates come
+    from ``steer.plan_unit_steered`` primed with the merged per-family
+    stats of those completed generations — identical stats on any
+    worker, so the adaptive plan keeps the fleet's partition
+    invariance. A worker that finds the current generation fully
+    leased elsewhere drains its own in-flight units first, then
+    sleep-polls for the barrier (peer crashes resolve through the
+    normal lease-expiry reclaim). Steered candidate records additionally
+    carry their ``family`` key, which is what the stats fold reads.
     """
     from ..engine.stream import stream_sweep
+
+    steered = ccfg.scheduler == "bandit"
+    if steered:
+        from .steer import SteerConfig, family_key, fold_family_stats, \
+            plan_unit_steered
+
+        scfg = steer_cfg if steer_cfg is not None else SteerConfig()
 
     gate = None
     if not skip_gate:
@@ -207,6 +237,7 @@ def run_worker(
     fed: List[Tuple[int, List[object]]] = []  # feed order: (unit, specs)
     leases: Dict[int, object] = {}  # unit -> live Lease
     pending: Dict[int, List[Optional[dict]]] = {}  # unit -> K summaries
+    unit_fams: Dict[int, List[int]] = {}  # steered: unit -> family masks
     my_units: List[int] = []
     my_fps: set = set()
     stored = 0  # units finalized by THIS worker (crash-drill counter)
@@ -225,13 +256,66 @@ def run_worker(
                 help="units currently leased by this worker",
             )
 
+    def _steer_limit() -> int:
+        """The generation barrier: units are leasable only up to the
+        end of the first generation containing a not-done unit, so the
+        stats a later unit's plan consults are frozen before any worker
+        can lease it."""
+        g = max(1, scfg.gen_units)
+        first_open = units
+        for u in range(units):
+            if not store.is_done(u):
+                first_open = u
+                break
+        return min(units, (first_open // g + 1) * g)
+
+    def _steer_stats(unit: int) -> dict:
+        """Per-family stats over the COMPLETED generations below
+        ``unit`` — a pure function of their (immutable, min-combined)
+        records, identical on every worker by the generation barrier."""
+        cutoff = (unit // max(1, scfg.gen_units)) * max(1, scfg.gen_units)
+        if cutoff == 0:
+            return {}
+        merged = store.merged()
+        return fold_family_stats(
+            [
+                (key, p)
+                for (kind, key), p in merged.items()
+                if kind == KIND_CAND and int(p["unit"]) < cutoff
+            ],
+            [
+                (key, p)
+                for (kind, key), p in merged.items()
+                if kind == KIND_BUG and int(p["unit"]) < cutoff
+            ],
+        )
+
     def acquire() -> Optional[dict]:
         """Lease the next unit and build its feed segment."""
         if max_units is not None and len(my_units) >= max_units:
             return None
         while True:
-            lease = store.next_lease(units)
+            limit = _steer_limit() if steered else units
+            lease = store.next_lease(limit)
             if lease is None:
+                if steered and limit < units:
+                    # the open generation is leased elsewhere and later
+                    # units are barrier-gated: drain our own in-flight
+                    # units first (their finalize may complete the
+                    # generation), else wait for peers / lease expiry
+                    if pending:
+                        return None
+                    if store.all_done(units):
+                        return None
+                    if telemetry is not None:
+                        telemetry.count(
+                            "steer_gen_waits_total",
+                            help="generation-barrier waits while peers "
+                            "finish the open generation",
+                        )
+                    time.sleep(0.05)
+                    heartbeat()
+                    continue
                 return None
             if lease.unit in pending:
                 # our own expired lease came back through the reclaim
@@ -239,7 +323,14 @@ def run_worker(
                 leases[lease.unit] = lease
                 continue
             break
-        specs = plan_unit(base_spec, ccfg, lease.unit)
+        if steered:
+            planned = plan_unit_steered(
+                base_spec, ccfg, scfg, lease.unit, _steer_stats(lease.unit)
+            )
+            unit_fams[lease.unit] = [m for m, _ in planned]
+            specs = [sp for _, sp in planned]
+        else:
+            specs = plan_unit(base_spec, ccfg, lease.unit)
         fed.append((lease.unit, specs))
         leases[lease.unit] = lease
         pending[lease.unit] = [None] * k
@@ -265,24 +356,27 @@ def run_worker(
         (partition invariance)."""
         nonlocal stored
         summaries = pending.pop(unit)
+        fams = unit_fams.pop(unit, None)
         unit_fps: Dict[str, Tuple[int, object, int]] = {}
         for ci, (spec, summary) in enumerate(zip(specs, summaries)):
             vio = summary.get("violating_seeds", [])
-            store.append(
-                KIND_CAND,
-                f"{unit:06d}/{ci:02d}",
-                {
-                    "unit": unit,
-                    "cand": ci,
-                    "spec": spec_to_dict(spec),
-                    "violations": int(summary["violations"]),
-                    "violating_seeds": [int(x) for x in vio],
-                    "coverage_map": [
-                        int(w) for w in summary.get("coverage_map", [])
-                    ],
-                    "events_total": int(summary.get("events_total", 0)),
-                },
-            )
+            payload = {
+                "unit": unit,
+                "cand": ci,
+                "spec": spec_to_dict(spec),
+                "violations": int(summary["violations"]),
+                "violating_seeds": [int(x) for x in vio],
+                "coverage_map": [
+                    int(w) for w in summary.get("coverage_map", [])
+                ],
+                "events_total": int(summary.get("events_total", 0)),
+            }
+            if fams is not None:
+                # the steered plan's family attribution — what
+                # fold_family_stats reads back; uniform payloads stay
+                # byte-identical to the pre-steering format
+                payload["family"] = family_key(fams[ci])
+            store.append(KIND_CAND, f"{unit:06d}/{ci:02d}", payload)
             for seed in vio:
                 f = triage_seed(
                     target, envelope, int(seed), history=history,

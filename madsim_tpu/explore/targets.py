@@ -111,6 +111,26 @@ def amnesia_gate(smoke: bool = True):
     return target, base
 
 
+# the (target, base FaultSpec) pairs the steering A/B drills sweep
+# (scripts/steer_demo.py, bench.py --steering, the determinism gate's
+# steering leg). The raft pair reuses the amnesia gate spec on purpose:
+# its base family is pure crashes, so the default family universe
+# (explore.steer.family_universe) is mostly amnesia-blind duds — the
+# exact shape where a uniform grid burns budget and the bandit's
+# early-kill pays. The etcd pair reuses the oracle demo's partition
+# spec the same way.
+def steer_gate(smoke: bool = True):
+    return amnesia_gate(smoke)
+
+
+def etcd_steer_gate(smoke: bool = True):
+    target = stale_etcd_target(
+        time_limit_ns=1_000_000_000 if smoke else 2_000_000_000,
+        max_steps=10_000 if smoke else 20_000,
+    )
+    return target, oracle_demo_faults()
+
+
 # the fault environment the history-oracle pipeline runs under — ONE
 # definition shared by scripts/oracle_demo.py, scripts/replay_seed.py
 # (--model etcd) and the determinism gate's history leg, so a seed one

@@ -507,6 +507,40 @@ def _probe(w: EtcdState):
     return w.viol_kind
 
 
+N_KINDS = 5  # K_OP..K_FAULT
+
+
+def cover_bits(cfg: EtcdConfig) -> int:
+    """Size of the coverage bitmap: one bit per (event kind, node,
+    facet) plus one bit per violation flavor. The facet is the message
+    type for K_MSG and the fault action for K_FAULT (the two kinds with
+    interesting substructure), 0 otherwise."""
+    return N_KINDS * cfg.num_nodes * 4 + 2
+
+
+def _cover(cfg: EtcdConfig, wb: EtcdState, wa: EtcdState, now, kind, pay):
+    """Map one dispatched event to its coverage bit (engine contract:
+    ``Workload.cover``) — the swarm-testing signal the explore loop's
+    retention and the steering bandit (explore/steer.py) feed on. A
+    newly latched violation flavor claims the event's bit instead,
+    mirroring models/raft.py (flavor bits are the rarest coverage)."""
+    node = jnp.where(kind == K_FAULT, pay[1], pay[0])
+    node = jnp.clip(node, 0, cfg.num_nodes - 1)
+    facet = jnp.where(
+        kind == K_MSG,
+        jnp.clip(pay[1], 0, 3),
+        jnp.where(kind == K_FAULT, jnp.clip(pay[0], 0, 3), 0),
+    )
+    bit = (kind * cfg.num_nodes + node) * 4 + facet
+    base = N_KINDS * cfg.num_nodes * 4
+    new_viol = wa.viol_kind & ~wb.viol_kind
+    return jnp.where(
+        new_viol != 0,
+        base + jnp.where((new_viol & V_REV) != 0, 0, 1),
+        bit,
+    )
+
+
 def _record(cfg: EtcdConfig, wb: EtcdState, wa: EtcdState, now, kind, pay):
     """Map one dispatched event to its op-history record (engine
     contract: ``Workload.record`` — at most ONE row per event).
@@ -656,6 +690,8 @@ def workload(cfg: EtcdConfig = None) -> Workload:
         payload_slots=PAYLOAD_SLOTS,
         max_emits=2,
         probe=_probe,
+        cover=partial(_cover, cfg),
+        cover_bits=cover_bits(cfg),
         record=partial(_record, cfg) if cfg.hist_slots > 0 else None,
         hist_slots=cfg.hist_slots,
     )

@@ -19,6 +19,11 @@
 # A fleet leg runs the leased-unit orchestrator over a shared corpus
 # store with 1 and 2 workers, twice each, and byte-diffs the merged
 # report across all four runs (docs/fleet.md merge contract).
+# A steering leg runs the pinned bandit campaign across 2 processes x
+# telemetry {on,off} and byte-diffs BOTH the campaign report and the
+# decision trace across all four runs (docs/steering.md determinism
+# contract: every scheduling decision a pure function of recorded
+# outcomes + the campaign seed, telemetry strictly out-of-band).
 # A serving-core leg runs the seeded wire_load determinism transcript
 # (kafka + S3 + framed etcd, injected clocks) across two processes x
 # {async core, legacy servers} x {telemetry on, off} and byte-diffs the
@@ -348,6 +353,40 @@ if cmp -s "$out/a.npz" "$out/b.npz"; then
     echo "determinism gate: FAILED — fleet merged reports differ or are empty" >&2
     for f in "$out"/fleet_*.jsonl; do echo "--- $f"; cat "$f"; done >&2 || true
     cat "$out"/fleet_*.log >&2 || true
+    exit 1
+  fi
+
+  # steering leg (docs/steering.md): the pinned bandit campaign — the
+  # UCB family scheduler driving the streaming service — must emit a
+  # byte-identical campaign report AND decision trace across 2 driver
+  # processes x telemetry {on,off}. The trace is the scheduler's whole
+  # decision sequence (cold plays, UCB picks, escalations, kills), so
+  # one diff pins every allocation choice, not just the sweep results.
+  for r in a b; do
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/steer_demo.py \
+      --policy bandit --budget 30000 \
+      --report "$out/steer_$r.jsonl" --trace "$out/steer_$r.trace.jsonl" \
+      >"$out/steer_$r.log" 2>&1
+    JAX_PLATFORMS=cpu "${PY:-python}" scripts/steer_demo.py \
+      --policy bandit --budget 30000 --telemetry-dir "$out/obs_steer_$r" \
+      --report "$out/steer_${r}_telem.jsonl" \
+      --trace "$out/steer_${r}_telem.trace.jsonl" \
+      >"$out/steer_${r}_telem.log" 2>&1
+  done
+  if [ -s "$out/steer_a.jsonl" ] && [ -s "$out/steer_a.trace.jsonl" ] \
+    && cmp -s "$out/steer_a.jsonl" "$out/steer_b.jsonl" \
+    && cmp -s "$out/steer_a.jsonl" "$out/steer_a_telem.jsonl" \
+    && cmp -s "$out/steer_a.jsonl" "$out/steer_b_telem.jsonl" \
+    && cmp -s "$out/steer_a.trace.jsonl" "$out/steer_b.trace.jsonl" \
+    && cmp -s "$out/steer_a.trace.jsonl" "$out/steer_a_telem.trace.jsonl" \
+    && cmp -s "$out/steer_a.trace.jsonl" "$out/steer_b_telem.trace.jsonl" \
+    && [ -s "$out/obs_steer_a/bandit.journal.jsonl" ]; then
+    echo "determinism gate: OK (steered campaign, 2 processes x telemetry on/off, byte-identical report + decision trace)"
+  else
+    echo "determinism gate: FAILED — steered campaign report/trace differ or are empty" >&2
+    diff "$out/steer_a.jsonl" "$out/steer_b.jsonl" >&2 || true
+    diff "$out/steer_a.trace.jsonl" "$out/steer_a_telem.trace.jsonl" >&2 || true
+    cat "$out"/steer_*.log >&2 || true
     exit 1
   fi
 else

@@ -1,0 +1,367 @@
+"""The self-steering scheduler's pure-host layer (explore/steer.py).
+
+The contracts under test (docs/steering.md): family keying partitions
+the envelope stably, mutation-chain candidates regenerate
+bit-identically anywhere (mask-confined, salt-namespaced), the UCB
+bandit's decisions are a pure function of the absorbed outcome prefix
+(cold order, exploit, deterministic tie-break), kill/escalate
+transitions fire exactly at their thresholds (and never orphan the
+last live family), uniform mode is the matched round-robin with NO
+transitions, the fleet's stats fold is partition-invariant, and a
+replayed decision sequence emits byte-identical trace lines. Nothing
+here touches a device: the real sweeps live in scripts/steer_demo.py
+(``make steer-smoke``) and the check_determinism.sh steering leg.
+"""
+
+import json
+
+from madsim_tpu.engine.faults import FaultSpec
+from madsim_tpu.explore.campaign import _COUNT_FIELDS, CampaignConfig
+from madsim_tpu.explore.steer import (
+    BanditScheduler,
+    SteerConfig,
+    _jfields,
+    family_candidate,
+    family_key,
+    family_of,
+    family_universe,
+    fold_family_stats,
+    plan_unit_steered,
+)
+
+_CRASHY = FaultSpec(crashes=2, crash_window_ns=1_500_000_000)
+
+
+# -- family keying ----------------------------------------------------------
+
+
+def test_family_of_is_the_active_category_bitmask():
+    assert family_of(FaultSpec()) == 0
+    assert family_of(_CRASHY) == 0x001  # crashes = bit 0
+    assert family_of(FaultSpec(partitions=1)) == 0x002
+    assert family_of(FaultSpec(crashes=1, partitions=2, skews=3)) == 0x103
+    # windows/durations never affect the family
+    assert family_of(
+        _CRASHY._replace(crash_window_ns=1)
+    ) == family_of(_CRASHY)
+
+
+def test_family_key_is_fixed_width_sortable_hex():
+    assert family_key(0x001) == "001"
+    assert family_key(0x1FF) == "1ff"
+    keys = [family_key(m) for m in range(0x200)]
+    assert keys == sorted(keys)
+
+
+def test_family_universe_crashes_base_is_17_families():
+    # 9 singles + the base (already the crashes single) + base|each
+    # other single = 17 sorted, deduped masks — the mostly-dud universe
+    # the A/B runs on (docs/steering.md)
+    uni = family_universe(_CRASHY)
+    assert len(uni) == 17
+    assert uni == tuple(sorted(uni))
+    assert 0x001 in uni and 0x003 in uni and 0x101 in uni
+    singles = {1 << i for i in range(len(_COUNT_FIELDS))}
+    assert singles <= set(uni)
+
+
+def test_family_universe_empty_base_is_the_singles():
+    assert family_universe(FaultSpec()) == tuple(
+        1 << i for i in range(len(_COUNT_FIELDS))
+    )
+
+
+# -- mutation-chain candidates ----------------------------------------------
+
+
+def test_family_candidate_lineage0_is_the_masked_base():
+    spec = family_candidate(_CRASHY, 0x001, 7, 0)
+    assert spec == _CRASHY
+    # off-mask categories are forced quiet, on-mask active
+    spec = family_candidate(_CRASHY, 0x002, 7, 0)
+    assert spec.crashes == 0 and spec.partitions >= 1
+
+
+def test_family_candidate_is_pure_and_chains_move():
+    a = family_candidate(_CRASHY, 0x003, 7, 3)
+    b = family_candidate(_CRASHY, 0x003, 7, 3)
+    assert a == b
+    chain = [family_candidate(_CRASHY, 0x003, 7, i) for i in range(4)]
+    assert all(x != y for x, y in zip(chain, chain[1:]))
+    # confinement holds along the whole chain
+    for spec in chain:
+        assert family_of(spec) == 0x003
+    # a different campaign seed is a different chain
+    assert family_candidate(_CRASHY, 0x003, 8, 3) != a
+
+
+def test_family_candidate_single_category_chains_still_move():
+    # mutations hitting off-mask fields no-op after re-masking; the
+    # bounded retry must keep even 1-bit-mask chains moving
+    chain = [family_candidate(_CRASHY, 0x002, 7, i) for i in range(3)]
+    assert all(x != y for x, y in zip(chain, chain[1:]))
+
+
+def test_family_candidate_salt_namespaces_and_offsets_chains():
+    # a salted chain starts one mutation deep: lineage 0 is NOT the
+    # masked base, and two salts diverge at every lineage — fleet units
+    # of one generation sweep distinct specs
+    base0 = family_candidate(_CRASHY, 0x001, 7, 0)
+    s1 = family_candidate(_CRASHY, 0x001, 7, 0, salt=1)
+    s2 = family_candidate(_CRASHY, 0x001, 7, 0, salt=2)
+    assert s1 != base0 and s2 != base0 and s1 != s2
+    assert family_candidate(_CRASHY, 0x001, 7, 0, salt=1) == s1
+
+
+# -- the bandit -------------------------------------------------------------
+
+_UNI = (0x001, 0x002, 0x004)
+
+
+def _sched(scfg=None, universe=_UNI, **kw):
+    scfg = scfg or SteerConfig()
+    kw.setdefault("seeds_per_play", 16)
+    kw.setdefault("budget_lo", 100)
+    kw.setdefault("budget_hi", 200)
+    return BanditScheduler(universe, scfg, **kw)
+
+
+def _barren(events=1000):
+    return {"events": events, "new_bits": 0, "vio": 0, "fresh": 0, "dup": 0}
+
+
+def test_cold_plays_cover_the_universe_in_mask_order():
+    s = _sched()
+    recs = [s.decide() for _ in range(3)]
+    assert [r["why"] for r in recs] == ["cold", "cold", "cold"]
+    assert [r["family"] for r in recs] == ["001", "002", "004"]
+    assert [r["i"] for r in recs] == [0, 1, 2]
+    assert all(r["seeds"] == 16 and r["budget"] == 100 for r in recs)
+
+
+def test_ucb_exploits_the_rewarding_family():
+    s = _sched(SteerConfig(kill_plays=99))
+    order = [s.decide()["family"] for _ in range(3)]
+    assert order == ["001", "002", "004"]
+    # 001 pays out; the others are barren at the same event cost
+    s.absorb(0x001, {"events": 1000, "new_bits": 40, "vio": 0,
+                     "fresh": 1, "dup": 0})
+    s.absorb(0x002, _barren())
+    s.absorb(0x004, _barren())
+    rec = s.decide()
+    assert rec["why"] == "ucb"
+    assert rec["family"] == "001"
+    assert rec["score_micro"] > 0
+
+
+def test_uniform_is_round_robin_with_no_transitions():
+    s = _sched(SteerConfig(scheduler="uniform", kill_plays=1))
+    fams = []
+    for _ in range(6):
+        rec = s.decide()
+        fams.append(rec["family"])
+        assert rec["why"] == "uniform"
+        # violations everywhere: uniform must neither kill nor escalate
+        s.absorb(int(rec["family"], 16),
+                 {"events": 10, "new_bits": 0, "vio": 3,
+                  "fresh": 0, "dup": 3})
+    assert fams == ["001", "002", "004"] * 2
+    assert not s.killed and not s.escalated
+    assert all(r["kind"] in ("decide", "outcome") for r in s.trace)
+
+
+def test_barren_family_is_killed_at_kill_plays():
+    s = _sched(SteerConfig(kill_plays=2))
+    for _ in range(3):
+        s.decide()
+    s.absorb(0x001, _barren())
+    assert not s.killed  # one barren play < kill_plays
+    s.decide()
+    s.absorb(0x001, _barren())
+    assert s.killed == {0x001: "barren"}
+    kills = [r for r in s.trace if r["kind"] == "kill"]
+    assert kills == [{"kind": "kill", "family": "001",
+                      "why": "barren", "at": 1}]
+    # killed families leave the pick rotation
+    assert 0x001 not in {int(s.decide()["family"], 16) for _ in range(4)}
+
+
+def test_dup_saturated_family_is_killed():
+    s = _sched(SteerConfig(kill_plays=2, kill_dup_rate_pct=90))
+    for _ in range(3):
+        s.decide()
+    # a rich first play (one fresh fingerprint, nine dups) leaves the
+    # family at a 90% dedup hit rate but NOT barren; the next all-dup
+    # play makes it stuck (barren >= 1) with the rate still saturated —
+    # the dup-saturated kill, distinct from the barren one (which would
+    # need kill_plays consecutive empty plays)
+    s.absorb(0x001, {"events": 10, "new_bits": 0, "vio": 10,
+                     "fresh": 1, "dup": 9})
+    assert not s.killed
+    s.decide()
+    s.absorb(0x001, {"events": 10, "new_bits": 0, "vio": 2,
+                     "fresh": 0, "dup": 2})
+    assert s.killed.get(0x001) == "dup-saturated"
+
+
+def test_last_live_family_is_never_killed():
+    s = _sched(SteerConfig(kill_plays=1), universe=(0x001,))
+    for _ in range(5):
+        s.decide()
+        s.absorb(0x001, _barren())
+    assert not s.killed
+
+
+def test_first_violation_escalates_seeds_and_budget():
+    s = _sched(SteerConfig(escalate_seeds=4, kill_plays=99))
+    for _ in range(3):
+        s.decide()
+    s.absorb(0x002, {"events": 10, "new_bits": 5, "vio": 1,
+                     "fresh": 1, "dup": 0})
+    assert s.escalated == [0x002]
+    esc = [r for r in s.trace if r["kind"] == "escalate"]
+    assert esc == [{"kind": "escalate", "family": "002", "at": 0}]
+    # a second violation in the same family does NOT re-escalate
+    s.absorb(0x001, {"events": 10, "new_bits": 0, "vio": 2,
+                     "fresh": 1, "dup": 1})
+    assert s.escalated == [0x002, 0x001]
+    # the hot family's next decision gets 4x seeds + the long budget
+    while True:
+        rec = s.decide()
+        if rec["family"] == "002":
+            break
+        s.absorb(int(rec["family"], 16), _barren())
+    assert rec["hot"] and rec["seeds"] == 64 and rec["budget"] == 200
+
+
+def test_replayed_decision_sequence_is_byte_identical():
+    def drill():
+        s = _sched(SteerConfig(kill_plays=2, escalate_seeds=3))
+        outcomes = {
+            "001": {"events": 900, "new_bits": 12, "vio": 1,
+                    "fresh": 1, "dup": 0},
+            "002": _barren(),
+            "004": {"events": 1100, "new_bits": 2, "vio": 0,
+                    "fresh": 0, "dup": 0},
+        }
+        for _ in range(2):
+            s.decide()
+        for _ in range(8):
+            rec = s.decide()
+            s.absorb(int(rec["family"], 16), outcomes[rec["family"]])
+        return s.trace_lines()
+
+    a, b = drill(), drill()
+    assert a == b
+    # every trace line is deterministic JSON: sorted keys, no wall times
+    for ln in a.splitlines():
+        rec = json.loads(ln)
+        assert list(rec) == sorted(rec)
+        assert "ts" not in rec and "wall" not in rec
+
+
+def test_scheduler_rejects_bad_config():
+    import pytest
+
+    with pytest.raises(ValueError):
+        _sched(universe=())
+    with pytest.raises(ValueError):
+        _sched(SteerConfig(scheduler="greedy"))
+
+
+# -- the fleet fold + steered unit plan -------------------------------------
+
+
+def _cand(unit, cand, fam, cov, vio=0, seeds=(), events=500):
+    return (
+        f"{unit:06d}/{cand:02d}",
+        {
+            "unit": unit, "cand": cand, "family": fam,
+            "coverage_map": cov, "violations": vio,
+            "violating_seeds": list(seeds), "events_total": events,
+        },
+    )
+
+
+def _bug(unit, cand, fp):
+    return (fp, {"unit": unit, "cand": cand, "fingerprint": fp})
+
+
+def test_fold_family_stats_counts_and_dedups():
+    cands = [
+        _cand(0, 0, "001", [0b0011], vio=2, seeds=[3, 9]),
+        _cand(0, 1, "002", [0b0100]),
+        _cand(1, 0, "001", [0b0011], vio=1, seeds=[5]),  # no new bits
+    ]
+    bugs = [
+        _bug(0, 0, "raft:f1:k2:n1"),
+        _bug(1, 0, "raft:f1:k2:n1"),  # dup of the first
+    ]
+    stats = fold_family_stats(cands, bugs)
+    st = stats[0x001]
+    assert st["plays"] == 2 and st["events"] == 1000
+    assert st["new_bits"] == 2  # only the first 001 candidate's bits
+    assert st["vio"] == 3
+    assert st["fresh"] == 1 and st["dup"] == 2
+    assert st["barren"] == 1  # the second 001 play earned nothing
+    assert stats[0x002]["new_bits"] == 1
+    assert stats[0x002]["barren"] == 0
+
+
+def test_fold_family_stats_is_input_order_invariant():
+    cands = [
+        _cand(0, 0, "001", [0b01], vio=1, seeds=[3]),
+        _cand(0, 1, "002", [0b10]),
+        _cand(1, 0, "004", [0b11]),
+    ]
+    bugs = [_bug(0, 0, "fp-a"), _bug(1, 0, "fp-b")]
+    fwd = fold_family_stats(cands, bugs)
+    rev = fold_family_stats(cands[::-1], bugs[::-1])
+    assert fwd == rev
+
+
+def test_fold_family_stats_skips_unsteered_records():
+    key, payload = _cand(0, 0, "001", [1])
+    del payload["family"]
+    assert fold_family_stats([(key, payload)], []) == {}
+
+
+def test_plan_unit_steered_is_deterministic_and_unit_salted():
+    ccfg = CampaignConfig(seeds_per_round=16, campaign_seed=7, batch=3)
+    scfg = SteerConfig(families=_UNI)
+    stats = {0x001: dict(plays=2, events=1000, new_bits=30, vio=1,
+                         fresh=1, dup=0, barren=0)}
+    p2 = plan_unit_steered(_CRASHY, ccfg, scfg, 2, stats)
+    p2b = plan_unit_steered(_CRASHY, ccfg, scfg, 2, dict(stats))
+    assert p2 == p2b  # any worker plans the unit identically
+    assert len(p2) == 3
+    # a generation peer picks from the same primed stats but sweeps
+    # DISTINCT candidates (unit-salted chains)
+    p3 = plan_unit_steered(_CRASHY, ccfg, scfg, 3, stats)
+    assert [m for m, _ in p2] == [m for m, _ in p3]
+    assert all(a != b for (_, a), (_, b) in zip(p2, p3))
+
+
+def test_plan_unit_steered_primes_escalation_and_kills():
+    ccfg = CampaignConfig(seeds_per_round=16, campaign_seed=7, batch=4)
+    scfg = SteerConfig(families=_UNI, kill_plays=1)
+    stats = {
+        0x001: dict(plays=1, events=500, new_bits=0, vio=0,
+                    fresh=0, dup=0, barren=1),  # killable on arrival
+        0x002: dict(plays=1, events=500, new_bits=9, vio=2,
+                    fresh=1, dup=0, barren=0),  # hot on arrival
+    }
+    plan = plan_unit_steered(_CRASHY, ccfg, scfg, 0, stats)
+    masks = [m for m, _ in plan]
+    assert 0x001 not in masks  # barren family killed before planning
+    assert 0x002 in masks  # the hot family keeps earning compute
+
+
+# -- journal mirroring ------------------------------------------------------
+
+
+def test_jfields_moves_kind_to_step():
+    rec = {"kind": "decide", "i": 4, "family": "001"}
+    out = _jfields(rec)
+    assert out == {"step": "decide", "i": 4, "family": "001"}
+    assert rec["kind"] == "decide"  # input untouched
